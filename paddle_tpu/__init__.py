@@ -1,0 +1,101 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the Fluid
+programming model.
+
+Rebuilt from scratch against the behavior of nchuCV/Paddle (PaddlePaddle
+Fluid 0.15): same Program/Block/Op graph API, layers, optimizers, readers and
+distributed surface — but lowered through JAX to XLA so entire blocks compile
+to single fused TPU programs, parallelism is jax.sharding over device meshes,
+and ragged sequences are padded+masked (static shapes for the MXU).
+
+Use it like the reference::
+
+    import paddle_tpu as fluid
+    img = fluid.layers.data(name="img", shape=[784])
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+"""
+from . import ops as _ops  # registers all op lowering rules  # noqa: F401
+
+from . import core
+from . import unique_name
+from . import framework
+from . import initializer
+from . import layers
+from . import nets
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import backward
+from . import io
+from . import metrics
+from . import average
+from . import profiler
+from . import lod as lod_tensor_mod
+
+from .core import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace
+from .framework import (
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .data_feeder import DataFeeder
+from .lod import LoDArray, create_lod_array
+from .evaluator import Evaluator
+
+create_lod_tensor = create_lod_array
+LoDTensor = LoDArray
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "core",
+    "framework",
+    "layers",
+    "nets",
+    "optimizer",
+    "initializer",
+    "regularizer",
+    "clip",
+    "backward",
+    "io",
+    "metrics",
+    "average",
+    "profiler",
+    "unique_name",
+    "Program",
+    "Variable",
+    "default_main_program",
+    "default_startup_program",
+    "program_guard",
+    "name_scope",
+    "Executor",
+    "ParallelExecutor",
+    "ExecutionStrategy",
+    "BuildStrategy",
+    "Scope",
+    "global_scope",
+    "scope_guard",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
+    "ParamAttr",
+    "WeightNormParamAttr",
+    "DataFeeder",
+    "LoDArray",
+    "LoDTensor",
+    "create_lod_tensor",
+    "create_lod_array",
+]
+
+# `import paddle_tpu.fluid as fluid` parity alias
+import sys as _sys
+
+fluid = _sys.modules[__name__]
+_sys.modules[__name__ + ".fluid"] = fluid

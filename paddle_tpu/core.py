@@ -1,0 +1,125 @@
+"""Device places and dtype utilities.
+
+TPU-native analog of the reference's ``paddle/fluid/platform/place.h`` and
+``framework/data_type.h``: a Place selects which jax backend the Executor
+compiles for; dtypes are plain strings mapped to numpy/jax dtypes.  Unlike the
+reference there is no per-op device dispatch — the whole block is compiled by
+XLA for one device (or a mesh of them).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Place:
+    """Base device place."""
+
+    _backend = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def jax_device(self):
+        """Resolve to a concrete jax device (best effort)."""
+        import jax
+
+        try:
+            devs = jax.devices(self._backend)
+        except RuntimeError:
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    _backend = "cpu"
+
+
+class TPUPlace(Place):
+    """The native device of this framework (reference: CUDAPlace)."""
+
+    _backend = None  # default backend = whatever jax.devices() leads with
+
+    def jax_device(self):
+        import jax
+
+        for be in ("tpu", "axon"):
+            try:
+                devs = jax.devices(be)
+                if devs:
+                    return devs[min(self.device_id, len(devs) - 1)]
+            except RuntimeError:
+                continue
+        return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+
+
+class CUDAPlace(TPUPlace):
+    """Compatibility alias so reference scripts run unmodified: maps to the
+    accelerator backend (TPU here)."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float32": "float32",
+    "fp32": "float32",
+    "float": "float32",
+    "float64": "float64",
+    "fp64": "float64",
+    "double": "float64",
+    "float16": "float16",
+    "fp16": "float16",
+    "bfloat16": "bfloat16",
+    "bf16": "bfloat16",
+    "int8": "int8",
+    "uint8": "uint8",
+    "int16": "int16",
+    "int32": "int32",
+    "int": "int32",
+    "int64": "int64",
+    "long": "int64",
+    "bool": "bool",
+}
+
+
+def canonical_dtype(dtype) -> str:
+    """Normalize a user dtype (str / np.dtype / jnp dtype) to a canonical
+    string name."""
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    name = str(name)
+    if name not in _DTYPE_ALIASES:
+        # np.dtype round trip for things like '<f4'
+        name = np.dtype(name).name
+    if name not in _DTYPE_ALIASES:
+        raise ValueError("unsupported dtype: %r" % (dtype,))
+    return _DTYPE_ALIASES[name]
+
+
+def np_dtype(dtype):
+    name = canonical_dtype(dtype)
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def is_float_dtype(dtype) -> bool:
+    return canonical_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")

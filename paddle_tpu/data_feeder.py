@@ -1,0 +1,82 @@
+"""DataFeeder (reference: python/paddle/fluid/data_feeder.py).
+
+Converts python/minibatch data into the executor feed dict.  Ragged (lod)
+slots become LoDArray (padded + lengths) — see lod.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core import np_dtype
+from .framework import Variable
+from .lod import LoDArray, pack_sequences
+
+__all__ = ["DataFeeder"]
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = shape
+        self.dtype = dtype
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(data)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.asarray(self.data, dtype=np_dtype(self.dtype))
+            if self.shape is not None:
+                want = [d for d in self.shape if d != -1]
+                if arr.ndim == 1 and len(want) > 0 and int(np.prod(want)) > 1:
+                    arr = arr.reshape((-1,) + tuple(int(d) for d in self.shape if d != -1))
+                elif arr.size == arr.shape[0] * int(np.prod(want or [1])):
+                    try:
+                        arr = arr.reshape((arr.shape[0],) + tuple(int(d) for d in (want or [])))
+                    except ValueError:
+                        pass
+            return arr
+        seqs = [np.asarray(d, dtype=np_dtype(self.dtype)) for d in self.data]
+        return pack_sequences(seqs, dtype=np_dtype(self.dtype))
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place, program=None):
+        from .framework import default_main_program
+
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should be a list of Variable")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape[1:] if each_var.shape else None)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(self.place, lod, shape, dtype)
+            for lod, shape, dtype in zip(self.feed_lod_level, self.feed_shapes, self.feed_dtypes)
+        ]
+        buffered = list(iterable) if not isinstance(iterable, (list, tuple)) else iterable
+        for each_sample in buffered:
+            assert len(each_sample) == len(converters), (
+                "sample has %d slots, feeder expects %d" % (len(each_sample), len(converters))
+            )
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {name: conv.done() for name, conv in zip(self.feed_names, converters)}
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Split a batch across places — retained for ParallelExecutor API
+        parity; sharding itself is handled by jax (parallel/executor.py)."""
+        yield self.feed(iterable)

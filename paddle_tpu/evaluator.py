@@ -1,0 +1,119 @@
+"""Evaluator (reference: python/paddle/fluid/evaluator.py — deprecated there
+in favor of fluid.metrics; kept for API parity)."""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from .executor import global_scope
+from .framework import Program, Variable, program_guard
+from .layer_helper import LayerHelper
+from .initializer import Constant
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+
+
+def _clone_var_(block, var):
+    return block.create_var(
+        name=var.name,
+        shape=var.shape,
+        dtype=var.dtype,
+        lod_level=var.lod_level,
+        persistable=True,
+    )
+
+
+class Evaluator:
+    """Accumulates metric states as persistable vars; ``eval`` runs a small
+    program over them."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn("better to use fluid.metrics instead", Warning)
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        scope = global_scope()
+        for var in self.states:
+            scope[var.name] = np.zeros(
+                [d if d > 0 else 1 for d in (var.shape or [1])],
+                dtype=var.dtype if isinstance(var.dtype, str) else "float32",
+            )
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_global_variable(
+            name="_".join([self.helper.name, str(suffix)]),
+            persistable=True,
+            dtype=dtype,
+            shape=shape,
+        )
+        self.helper.set_variable_initializer(state, Constant(0.0))
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    def __init__(self, input, label, chunk_scheme, num_chunk_types, excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        from .layers import sequence as seq_layers
+
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.num_infer_chunks = self._create_state(dtype="int64", shape=[1], suffix="num_infer_chunks")
+        self.num_label_chunks = self._create_state(dtype="int64", shape=[1], suffix="num_label_chunks")
+        self.num_correct_chunks = self._create_state(dtype="int64", shape=[1], suffix="num_correct_chunks")
+        from .layers import chunk_eval as chunk_eval_layer  # type: ignore
+
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks, num_correct_chunks) = chunk_eval_layer(
+            input=input,
+            label=label,
+            chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types,
+        )
+        from .layers import tensor as tl
+
+        tl.sums(input=[self.num_infer_chunks, num_infer_chunks], out=self.num_infer_chunks)
+        tl.sums(input=[self.num_label_chunks, num_label_chunks], out=self.num_label_chunks)
+        tl.sums(input=[self.num_correct_chunks, num_correct_chunks], out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        num_infer = float(np.asarray(scope[self.num_infer_chunks.name]).reshape(-1)[0])
+        num_label = float(np.asarray(scope[self.num_label_chunks.name]).reshape(-1)[0])
+        num_correct = float(np.asarray(scope[self.num_correct_chunks.name]).reshape(-1)[0])
+        precision = num_correct / num_infer if num_infer else 0.0
+        recall = num_correct / num_label if num_label else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if num_correct else 0.0
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super().__init__("edit_distance", **kwargs)
+        from .layers import edit_distance as edit_distance_layer  # type: ignore
+
+        distances, seq_num = edit_distance_layer(input=input, label=label, ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state(dtype="float32", shape=[1], suffix="total_distance")
+        self.seq_num = self._create_state(dtype="int64", shape=[1], suffix="seq_num")
+        from .layers import nn, tensor as tl
+
+        dist_sum = nn.reduce_sum(distances)
+        from .layers import tensor
+
+        tl.sums(input=[self.total_distance, dist_sum], out=self.total_distance)
+        tl.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        self.metrics.append(distances)
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        total = float(np.asarray(scope[self.total_distance.name]).reshape(-1)[0])
+        n = float(np.asarray(scope[self.seq_num.name]).reshape(-1)[0])
+        return np.array([total / n if n else 0.0])

@@ -1,0 +1,152 @@
+"""Model save/load + inference model (reference: python/paddle/fluid/io.py).
+
+Parameters live in the Scope as device arrays; save/load moves them to/from
+disk.  ``filename=None`` → one file per variable (reference layout);
+``filename=...`` → single combined ``.npz``.  Inference models serialize the
+pruned Program as JSON (``__model__``) + params, mirroring the reference's
+``__model__`` protobuf + param files.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .executor import Executor, global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "get_inference_program",
+    "is_parameter",
+    "is_persistable",
+    "get_parameter_value",
+    "get_parameter_value_by_name",
+]
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    return bool(var.persistable)
+
+
+def _var_bytes(scope, name):
+    val = scope.vars.get(name)
+    if val is None:
+        raise KeyError("variable %r has no value in scope (run startup first?)" % name)
+    return np.asarray(val)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = list(filter(predicate, main_program.list_vars()))
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for v in vars:
+            np.save(os.path.join(dirname, v.name + ".npy"), _var_bytes(scope, v.name))
+    else:
+        np.savez(
+            os.path.join(dirname, filename),
+            **{v.name: _var_bytes(scope, v.name) for v in vars},
+        )
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = list(filter(predicate, main_program.list_vars()))
+    scope = global_scope()
+    if filename is None:
+        for v in vars:
+            path = os.path.join(dirname, v.name + ".npy")
+            scope[v.name] = np.load(path)
+    else:
+        data = np.load(os.path.join(dirname, filename) + ("" if filename.endswith(".npz") else ".npz"))
+        for v in vars:
+            scope[v.name] = data[v.name]
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable, filename=filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    return main_program.prune(target_vars)
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    export_for_deployment=True,
+):
+    main_program = main_program or default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    inference_program = main_program.prune(target_vars)
+    model = {
+        "program": inference_program.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name if isinstance(v, Variable) else v for v in target_vars],
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
+        json.dump(model, f)
+    params = [v for v in inference_program.list_vars() if is_persistable(v)]
+    save_vars(executor, dirname, vars=params, filename=params_filename)
+    return model["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__")) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    params = [v for v in program.list_vars() if is_persistable(v)]
+    load_vars(executor, dirname, vars=params, filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
+    return program, model["feed_names"], fetch_vars
+
+
+def get_parameter_value(para, executor):
+    if not is_parameter(para):
+        raise TypeError("expected a Parameter")
+    return np.asarray(global_scope()[para.name])
+
+
+def get_parameter_value_by_name(name, executor, program=None):
+    program = program or default_main_program()
+    return get_parameter_value(program.global_block().var(name), executor)

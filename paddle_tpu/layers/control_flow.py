@@ -1,0 +1,796 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py).
+
+TPU-native design: sub-blocks lower to XLA structured control flow —
+``While`` → ``lax.while_loop``, ``ConditionalBlock``/``IfElse`` → ``lax.cond``,
+``Switch`` → nested conds.  Tensor arrays are fixed-capacity stacked buffers
+(static shapes), written with ``dynamic_update_index`` — the XLA-legal
+equivalent of the reference's LoDTensorArray.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Operator, Variable, default_main_program
+from ..layer_helper import LayerHelper
+from ..registry import register
+from . import tensor as tensor_layers
+
+__all__ = [
+    "While",
+    "Switch",
+    "increment",
+    "array_write",
+    "create_array",
+    "less_than",
+    "equal",
+    "array_read",
+    "array_length",
+    "IfElse",
+    "DynamicRNN",
+    "StaticRNN",
+    "ConditionalBlock",
+    "Print",
+    "is_empty",
+    "max_sequence_len",
+    "lod_rank_table",
+    "reorder_lod_tensor_by_rank",
+]
+
+# default capacity for tensor arrays written inside While loops; override per
+# array via create_array(capacity=...) or the While(maxlen=...) attr.
+DEFAULT_ARRAY_CAPACITY = 256
+
+
+def Print(
+    input,
+    first_n=-1,
+    message=None,
+    summarize=-1,
+    print_tensor_name=True,
+    print_tensor_type=True,
+    print_tensor_shape=True,
+    print_tensor_lod=True,
+    print_phase="both",
+):
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=input.shape)
+    helper.append_op(
+        type="print",
+        inputs={"In": [input]},
+        outputs={"Out": [out]},
+        attrs={"message": message or input.name},
+    )
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(type="increment", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None, **ignored):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
+    return cond
+
+
+def equal(x, y, cond=None, **ignored):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
+    return cond
+
+
+def is_empty(x, cond=None, **ignored):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(type="is_empty", inputs={"X": [x]}, outputs={"Out": [cond]})
+    return cond
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays: fixed-capacity stacked buffers + an int32 length scalar
+# ---------------------------------------------------------------------------
+
+
+def create_array(dtype, capacity=None):
+    """LoDTensorArray analog: variable of type lod_tensor_array, lowered as a
+    (buffer[capacity, ...], length) pair determined on first write."""
+    helper = LayerHelper("array")
+    arr = helper.block.create_var(
+        name=helper.name, dtype=dtype, type="lod_tensor_array"
+    )
+    arr.capacity = capacity or DEFAULT_ARRAY_CAPACITY
+    return arr
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(
+        type="write_to_array", inputs={"X": [x], "I": [i]}, outputs={"Out": [array]}
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(type="read_from_array", inputs={"X": [array], "I": [i]}, outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(dtype="int64", shape=[1], stop_gradient=True)
+    helper.append_op(type="lod_array_length", inputs={"X": [array]}, outputs={"Out": [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+
+
+class BlockGuard:
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program.rollback()
+        return exc_type is None
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op):
+        super().__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        self.while_op.status = While.IN_WHILE_BLOCK
+        return super().__enter__()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.while_op.status = While.AFTER_WHILE_BLOCK
+        self.while_op._complete()
+        return super().__exit__(exc_type, exc_val, exc_tb)
+
+
+class While:
+    """while (cond) { sub-block } → lax.while_loop.
+
+    The carried state is every outer-block variable written inside the
+    sub-block (plus tensor arrays).  Reference: control_flow.py:652 While.
+    """
+
+    BEFORE_WHILE_BLOCK = 0
+    IN_WHILE_BLOCK = 1
+    AFTER_WHILE_BLOCK = 2
+
+    def __init__(self, cond, is_test=False, name=None, maxlen=None):
+        self.helper = LayerHelper("while", name=name)
+        self.status = While.BEFORE_WHILE_BLOCK
+        if cond.dtype != "bool":
+            raise TypeError("condition must be a bool variable")
+        self.cond_var = cond
+        self.is_test = is_test
+        self.maxlen = maxlen
+
+    def block(self):
+        return WhileGuard(self)
+
+    def _complete(self):
+        main_program = self.helper.main_program
+        while_block = main_program.current_block()
+        parent_block = main_program.block(while_block.parent_idx)
+
+        # variables read from outer scope, and outer vars written inside
+        inner_written = set()
+        read = set()
+        for op in while_block.ops:
+            for name in op.all_input_names():
+                read.add(name)
+            for name in op.all_output_names():
+                inner_written.add(name)
+        x_names = sorted(
+            n for n in read
+            if not while_block.has_var(n) and parent_block.has_var_recursive(n)
+        )
+        carried = sorted(
+            n for n in inner_written
+            if not while_block.has_var(n) and parent_block.has_var_recursive(n)
+        )
+        parent_block.append_op(
+            type="while",
+            inputs={"X": x_names, "Condition": [self.cond_var]},
+            outputs={"Out": carried},
+            attrs={
+                "sub_block": while_block.idx,
+                "is_test": self.is_test,
+                "maxlen": self.maxlen,
+            },
+        )
+
+
+@register("while")
+def _while_lower(ctx, op):
+    """Lower a While op: carried env = condition + written outer vars +
+    tensor-array buffers/lengths touched in the sub-block."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..executor import interpret_ops
+
+    sub_block = op.sub_block
+    cond_name = op.inputs["Condition"][0]
+    carried_names = list(op.outputs.get("Out", []))
+    if cond_name not in carried_names:
+        carried_names = [cond_name] + carried_names
+    # include array state (buffer + length) for arrays written in sub block
+    array_names = []
+    for sop in sub_block.ops:
+        if sop.type == "write_to_array":
+            an = sop.outputs["Out"][0]
+            if an not in array_names:
+                array_names.append(an)
+
+    # initialize array buffers lazily: peek element shape by tracing one body
+    # run is fragile; instead allocate on first write inside the body using
+    # shape of X. Pre-seed length zero + None buffer sentinel handled below.
+    for an in array_names:
+        if not ctx.has(an + "@ARRAY"):
+            # allocate by abstract-eval of the first write's operand shape:
+            # find the write op and infer from its input var value lazily at
+            # first body trace. We allocate there; here seed length only.
+            ctx.set(an + "@ARRAYLEN", jnp.zeros((), dtype="int32"))
+
+    carry_keys = [cond_name] + [n for n in carried_names if n != cond_name]
+
+    def snapshot():
+        d = {}
+        for n in carry_keys:
+            d[n] = ctx.get(n)
+        for an in array_names:
+            if ctx.has(an + "@ARRAY"):
+                d[an + "@ARRAY"] = ctx.get(an + "@ARRAY")
+            d[an + "@ARRAYLEN"] = ctx.get(an + "@ARRAYLEN")
+        return d
+
+    # One eager body trace to materialize array buffers with correct shapes
+    # (write_to_array allocates on first touch), then roll into while_loop.
+    # To keep semantics exact we run the body trace on the *initial* env copy
+    # and only keep allocated zero-buffers.
+    probe_env = dict(ctx.env)
+    probe_ctx = ctx.child(probe_env)
+    interpret_ops(probe_ctx, sub_block.ops)
+    for an in array_names:
+        buf_key = an + "@ARRAY"
+        if buf_key in probe_env and not ctx.has(buf_key):
+            buf = probe_env[buf_key]
+            ctx.set(buf_key, jnp.zeros_like(buf))
+
+    init = snapshot()
+
+    def cond_fn(carry):
+        return carry[cond_name].reshape(()).astype(bool)
+
+    def body_fn(carry):
+        env2 = dict(ctx.env)
+        env2.update(carry)
+        c2 = ctx.child(env2)
+        interpret_ops(c2, sub_block.ops)
+        out = {}
+        for k in init:
+            out[k] = env2[k]
+        return out
+
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for k, v in final.items():
+        ctx.set(k, v)
+
+
+@register("write_to_array")
+def _write_to_array(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    i = ctx.get_input(op, "I").reshape(()).astype("int32")
+    arr_name = op.outputs["Out"][0]
+    buf_key = arr_name + "@ARRAY"
+    len_key = arr_name + "@ARRAYLEN"
+    var = ctx.var(arr_name, op.block)
+    capacity = getattr(var, "capacity", None) or DEFAULT_ARRAY_CAPACITY
+    if not ctx.has(buf_key):
+        ctx.set(buf_key, jnp.zeros((capacity,) + tuple(x.shape), dtype=x.dtype))
+    buf = ctx.get(buf_key)
+    buf = jax.lax.dynamic_update_index_in_dim(buf, x.astype(buf.dtype), i, 0)
+    ctx.set(buf_key, buf)
+    cur = ctx.get(len_key) if ctx.has(len_key) else jnp.zeros((), "int32")
+    ctx.set(len_key, jnp.maximum(cur, i + 1))
+
+
+@register("read_from_array")
+def _read_from_array(ctx, op):
+    import jax
+
+    arr_name = op.inputs["X"][0]
+    i = ctx.get_input(op, "I").reshape(()).astype("int32")
+    buf = ctx.get(arr_name + "@ARRAY")
+    ctx.set_output(op, "Out", jax.lax.dynamic_index_in_dim(buf, i, 0, keepdims=False))
+
+
+@register("lod_array_length")
+def _lod_array_length(ctx, op):
+    arr_name = op.inputs["X"][0]
+    ln = ctx.get(arr_name + "@ARRAYLEN")
+    ctx.set_output(op, "Out", ln.astype("int64").reshape(1))
+
+
+@register("is_empty")
+def _is_empty(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.asarray(int(np.prod(np.shape(x))) == 0).reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# ConditionalBlock / Switch / IfElse
+# ---------------------------------------------------------------------------
+
+
+class ConditionalBlockGuard(BlockGuard):
+    def __init__(self, cblock):
+        super().__init__(cblock.helper.main_program)
+        self.cblock = cblock
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.cblock._complete()
+        return super().__exit__(exc_type, exc_val, exc_tb)
+
+
+class ConditionalBlock:
+    """Run sub-block iff all inputs are true → lax.cond
+    (reference control_flow.py:1163)."""
+
+    def __init__(self, inputs, is_scalar_condition=False, name=None):
+        for e in inputs:
+            if not isinstance(e, Variable):
+                raise TypeError("inputs must be Variables")
+        self.inputs = inputs
+        self.is_scalar_condition = is_scalar_condition
+        self.helper = LayerHelper("conditional_block", name=name)
+
+    def block(self):
+        return ConditionalBlockGuard(self)
+
+    def _complete(self):
+        main_program = self.helper.main_program
+        inside_block = main_program.current_block()
+        parent_block = main_program.block(inside_block.parent_idx)
+
+        inner_written = set()
+        read = set()
+        for op in inside_block.ops:
+            read |= set(op.all_input_names())
+            inner_written |= set(op.all_output_names())
+        param_list = sorted(
+            n for n in read if not inside_block.has_var(n) and parent_block.has_var_recursive(n)
+        )
+        out_list = sorted(
+            n for n in inner_written if not inside_block.has_var(n) and parent_block.has_var_recursive(n)
+        )
+        parent_block.append_op(
+            type="conditional_block",
+            inputs={"Cond": self.inputs, "Input": param_list},
+            outputs={"Out": out_list},
+            attrs={"sub_block": inside_block.idx, "is_scalar_condition": self.is_scalar_condition},
+        )
+
+
+@register("conditional_block")
+def _conditional_block_lower(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    from ..executor import interpret_ops
+
+    sub_block = op.sub_block
+    conds = ctx.get_inputs(op, "Cond")
+    pred = jnp.all(jnp.stack([c.reshape(-1).all() for c in conds]))
+    out_names = list(op.outputs.get("Out", []))
+
+    def run_true(env_in):
+        env2 = dict(env_in)
+        c2 = ctx.child(env2)
+        interpret_ops(c2, sub_block.ops)
+        return {n: env2[n] for n in out_names if n in env2}
+
+    def run_false(env_in):
+        out = {}
+        for n in out_names:
+            if n in env_in:
+                out[n] = env_in[n]
+            else:
+                # var never assigned: zeros of the probe shape
+                out[n] = None
+        return out
+
+    # probe to learn shapes of outs not yet bound
+    probe = run_true(dict(ctx.env))
+    fallback = {}
+    for n in out_names:
+        if ctx.has(n):
+            fallback[n] = ctx.get(n)
+        elif n in probe:
+            fallback[n] = jnp.zeros_like(probe[n])
+    env_now = {k: v for k, v in ctx.env.items()}
+
+    def t_branch(_):
+        return run_true(env_now)
+
+    def f_branch(_):
+        return {n: fallback[n] for n in fallback}
+
+    result = jax.lax.cond(pred, t_branch, f_branch, operand=None)
+    for n, v in result.items():
+        ctx.set(n, v)
+
+
+class Switch:
+    """switch { case(cond): ... default: ... }
+    (reference control_flow.py:1277).  Each case appends a ConditionalBlock
+    on (cond & not any-previous-cond)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.inside_scope = False
+        self.pre_not_conditions = []
+
+    def case(self, condition):
+        if not self.inside_scope:
+            raise ValueError("case should be called inside with")
+        from . import nn
+
+        if len(self.pre_not_conditions) == 0:
+            cond_block = ConditionalBlock([condition], is_scalar_condition=True)
+            not_cond = nn.logical_not(x=condition)
+            self.pre_not_conditions.append(not_cond)
+        else:
+            pre_cond_num = len(self.pre_not_conditions)
+            pre_not_cond = self.pre_not_conditions[pre_cond_num - 1]
+            new_not_cond = nn.logical_and(x=pre_not_cond, y=nn.logical_not(x=condition))
+            self.pre_not_conditions.append(new_not_cond)
+            cond_block = ConditionalBlock(
+                [nn.logical_and(x=pre_not_cond, y=condition)], is_scalar_condition=True
+            )
+        return ConditionalBlockGuard(cond_block)
+
+    def default(self):
+        pre_cond_num = len(self.pre_not_conditions)
+        if pre_cond_num == 0:
+            raise ValueError("there should be at least one condition")
+        cond_block = ConditionalBlock(
+            [self.pre_not_conditions[pre_cond_num - 1]], is_scalar_condition=True
+        )
+        return ConditionalBlockGuard(cond_block)
+
+    def __enter__(self):
+        self.inside_scope = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.inside_scope = False
+        return exc_type is None
+
+
+class IfElse:
+    """Batch-level two-way branch (reference control_flow.py:1420).
+
+    TPU-native: instead of physically splitting the batch by the bool mask
+    (dynamic shapes), both branches run on the full batch and results merge
+    by mask — identical math, static shapes."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.input_table = {}
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.conditional_true_block = None
+        self.output_table = [[], []]  # [false_outs, true_outs]
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input must be inside true/false blocks")
+        # mask-select: x where cond matches this branch, else zeros
+        from . import nn
+
+        branch = self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+        mask = self.cond if branch else nn.logical_not(self.cond)
+        maskf = tensor_layers.cast(mask, x.dtype)
+        return nn.elementwise_mul(x, maskf, axis=0)
+
+    class _Guard:
+        def __init__(self, ie, branch):
+            self.ie = ie
+            self.branch = branch
+
+        def __enter__(self):
+            self.ie.status = (
+                IfElse.IN_IF_ELSE_TRUE_BLOCKS if self.branch else IfElse.IN_IF_ELSE_FALSE_BLOCKS
+            )
+
+        def __exit__(self, *a):
+            self.ie.status = IfElse.OUT_IF_ELSE_BLOCKS
+            return a[0] is None
+
+    def true_block(self):
+        return IfElse._Guard(self, True)
+
+    def false_block(self):
+        return IfElse._Guard(self, False)
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output must be inside true/false blocks")
+        idx = 1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0
+        self.output_table[idx].extend(outs)
+
+    def __call__(self):
+        from . import nn
+
+        false_outs, true_outs = self.output_table
+        if len(false_outs) != len(true_outs):
+            if not false_outs:
+                return list(true_outs)
+            if not true_outs:
+                return list(false_outs)
+            raise ValueError("true/false blocks must output the same arity")
+        rets = []
+        for f, t in zip(false_outs, true_outs):
+            maskf = tensor_layers.cast(self.cond, t.dtype)
+            rets.append(
+                nn.elementwise_add(
+                    nn.elementwise_mul(t, maskf, axis=0),
+                    nn.elementwise_mul(f, nn.elementwise_sub(tensor_layers.fill_constant([1], t.dtype, 1.0), maskf), axis=0),
+                )
+            )
+        return rets
+
+
+class StaticRNN:
+    """Unrolled RNN over a fixed number of steps → emitted as a scan op
+    (reference control_flow.py:397).  See sequence.py for the scan-based
+    dynamic_lstm/gru, which are the TPU-preferred entry points."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.memories = {}
+        self.inputs = []
+        self.outputs = []
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_len = None
+        self._mem_links = []
+
+    class _Guard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn.status = StaticRNN.IN_RNN_BLOCK
+            self.rnn.helper.main_program.create_block()
+
+        def __exit__(self, exc_type, *a):
+            if exc_type is not None:
+                return False
+            self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+            self.rnn._complete()
+            self.rnn.helper.main_program.rollback()
+            return True
+
+    def step(self):
+        return StaticRNN._Guard(self)
+
+    def step_input(self, x):
+        """x: [batch, seq, ...] outer var; returns per-step slice var."""
+        if self.seq_len is None:
+            self.seq_len = x.shape[1]
+        helper = self.helper
+        ipt = helper.main_program.current_block().create_var(
+            name=helper.name + "_in_" + x.name,
+            dtype=x.dtype,
+            shape=(x.shape[0],) + tuple(x.shape[2:]) if x.shape else None,
+        )
+        self.inputs.append((x, ipt))
+        return ipt
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        helper = self.helper
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init or (shape, batch_ref)")
+            init = tensor_layers.fill_constant_batch_size_like(
+                input=batch_ref, shape=[-1] + list(shape[1:]) if shape[0] in (-1,) else list(shape),
+                dtype="float32", value=init_value, input_dim_idx=ref_batch_dim_idx if ref_batch_dim_idx != 1 else 0,
+            )
+        mem = helper.main_program.current_block().create_var(
+            name=helper.name + "_mem_" + init.name, dtype=init.dtype, shape=init.shape
+        )
+        self.memories[mem.name] = [init, None]
+        return mem
+
+    def update_memory(self, mem, var):
+        self.memories[mem.name][1] = var
+
+    def step_output(self, o):
+        self.outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        main_program = self.helper.main_program
+        rnn_block = main_program.current_block()
+        parent_block = main_program.block(rnn_block.parent_idx)
+        out_vars = []
+        for o in self.outputs:
+            ov = parent_block.create_var(
+                name=self.helper.name + "_out_" + o.name, dtype=o.dtype,
+            )
+            out_vars.append(ov)
+        self.out_vars = out_vars
+        parent_block.append_op(
+            type="static_rnn",
+            inputs={
+                "Inputs": [x for x, _ in self.inputs],
+                "InitStates": [init for init, _ in self.memories.values()],
+            },
+            outputs={"Outputs": out_vars},
+            attrs={
+                "sub_block": rnn_block.idx,
+                "step_inputs": [ipt.name for _, ipt in self.inputs],
+                "mem_names": list(self.memories.keys()),
+                "mem_updates": [upd.name if upd is not None else "" for _, upd in self.memories.values()],
+                "step_outputs": [o.name for o in self.outputs],
+                "seq_len": self.seq_len,
+            },
+        )
+
+    def __call__(self, *args, **kwargs):
+        outs = self.out_vars
+        if len(outs) == 1:
+            return outs[0]
+        return outs
+
+
+@register("static_rnn")
+def _static_rnn_lower(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    from ..executor import interpret_ops
+
+    sub_block = op.sub_block
+    a = op.attrs
+    xs = ctx.get_inputs(op, "Inputs")  # each [batch, seq, ...]
+    inits = ctx.get_inputs(op, "InitStates")
+    step_in_names = a["step_inputs"]
+    mem_names = a["mem_names"]
+    mem_updates = a["mem_updates"]
+    step_out_names = a["step_outputs"]
+
+    def body(carry, xt):
+        env2 = dict(ctx.env)
+        for n, v in zip(mem_names, carry):
+            env2[n] = v
+        for n, v in zip(step_in_names, xt):
+            env2[n] = v
+        c2 = ctx.child(env2)
+        interpret_ops(c2, sub_block.ops)
+        new_carry = [
+            env2[u] if u else env2[n] for n, u in zip(mem_names, mem_updates)
+        ]
+        outs = [env2[n] for n in step_out_names]
+        return tuple(new_carry), tuple(outs)
+
+    xs_t = tuple(jnp.swapaxes(x, 0, 1) for x in xs)  # [seq, batch, ...]
+    _, outs = jax.lax.scan(body, tuple(inits), xs_t)
+    for name, o in zip(op.outputs["Outputs"], outs):
+        ctx.set(name, jnp.swapaxes(o, 0, 1))  # back to [batch, seq, ...]
+
+
+class DynamicRNN:
+    """Reference control_flow.py:1560.  In this framework ragged batches are
+    padded+masked, so DynamicRNN is StaticRNN over max_len with masked memory
+    updates; provided for API parity."""
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name=name)
+        self._lengths = None
+        self._step_mask = None
+
+    def block(self):
+        return self._rnn.step()
+
+    def step_input(self, x, lengths=None):
+        ipt = self._rnn.step_input(x)
+        return ipt
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False, dtype="float32"):
+        return self._rnn.memory(init=init, shape=shape, init_value=value)
+
+    def update_memory(self, ex_mem, new_mem):
+        self._rnn.update_memory(ex_mem, new_mem)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def __call__(self):
+        return self._rnn()
+
+
+# LoD-rank-table machinery: the reference used it to sort sequences by length
+# before While-based RNNs (control_flow.py:894).  With padded+masked ragged
+# tensors there is nothing to reorder; these are thin parity shims.
+
+
+def lod_rank_table(x, level=0):
+    helper = LayerHelper("lod_rank_table")
+    table = helper.block.create_var(name=helper.name, dtype="int64", type="raw")
+    table.source = x
+    return table
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper("max_seq_len")
+    out = helper.create_variable_for_type_inference(dtype="int64", shape=[1], stop_gradient=True)
+    helper.append_op(
+        type="max_sequence_len", inputs={"X": [rank_table.source]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+@register("max_sequence_len")
+def _max_sequence_len(ctx, op):
+    import jax.numpy as jnp
+
+    name = op.inputs["X"][0]
+    lens = ctx.get_lengths(name)
+    if lens is None:
+        x = ctx.get(name)
+        out = jnp.asarray([x.shape[1]], dtype="int64")
+    else:
+        out = jnp.max(lens).astype("int64").reshape(1)
+    ctx.set_output(op, "Out", out)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    return x

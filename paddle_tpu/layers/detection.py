@@ -1,0 +1,9 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py).
+
+Populated in the detection phase (SSD stack: prior_box, multi_box_head,
+box_coder, bipartite_match, target_assign, ssd_loss, detection_output,
+iou_similarity, detection mAP).
+"""
+from __future__ import annotations
+
+__all__ = []

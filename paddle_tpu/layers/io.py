@@ -1,0 +1,150 @@
+"""Data-side layers (reference: python/paddle/fluid/layers/io.py).
+
+``data`` declares a feed slot.  ``py_reader`` / ``open_recordio_file`` create
+host-side prefetching pipelines (the TPU analog of the reference's
+double-buffered readers: data is staged on host and device_put overlaps with
+compute because jax dispatch is async).
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["data", "read_file", "py_reader", "shuffle", "batch", "double_buffer", "open_recordio_file", "open_files"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type=None, stop_gradient=True):
+    """Declare an input slot. With append_batch_size (default, as reference
+    layers/io.py:24) a leading -1 batch dim is added."""
+    helper = LayerHelper("data")
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.block.program.global_block().create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        is_data=True,
+        stop_gradient=stop_gradient,
+    )
+
+
+class _PyReader:
+    """Host-side prefetch queue bound to feed slots.  ``decorate_paddle_reader``
+    / ``start`` / ``reset`` mirror the reference py_reader surface; iteration
+    happens in Executor.run via the feeder hook."""
+
+    def __init__(self, capacity, shapes, dtypes, lod_levels, names):
+        import queue
+
+        self.capacity = capacity
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.lod_levels = lod_levels
+        self.names = names
+        self.queue = queue.Queue(maxsize=capacity)
+        self._reader = None
+        self._thread = None
+        self._stop = False
+        self.vars = None
+
+    def decorate_paddle_reader(self, reader):
+        self._reader = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+
+    def start(self):
+        import threading
+
+        self._stop = False
+
+        def worker():
+            try:
+                for item in self._reader():
+                    if self._stop:
+                        return
+                    self.queue.put(item)
+            finally:
+                self.queue.put(None)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop = True
+        if self._thread is not None:
+            while not self.queue.empty():
+                self.queue.get_nowait()
+            self._thread.join(timeout=1.0)
+        self.queue = __import__("queue").Queue(maxsize=self.capacity)
+
+    def next(self):
+        item = self.queue.get()
+        if item is None:
+            raise StopIteration
+        return item
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None, use_double_buffer=True):
+    names = []
+    vars_ = []
+    lod_levels = lod_levels or [0] * len(shapes)
+    for i, (shape, dtype, ll) in enumerate(zip(shapes, dtypes, lod_levels)):
+        v = data(
+            name=(name or "py_reader") + "_slot%d" % i,
+            shape=list(shape)[1:],
+            dtype=dtype,
+            lod_level=ll,
+        )
+        names.append(v.name)
+        vars_.append(v)
+    r = _PyReader(capacity, shapes, dtypes, lod_levels, names)
+    r.vars = vars_
+    return r
+
+
+def read_file(reader):
+    if isinstance(reader, _PyReader):
+        return reader.vars
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    from ..reader import decorator
+
+    return decorator.shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size):
+    from .. import reader as reader_mod
+
+    return reader_mod.batch(reader, batch_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader
+
+
+def open_recordio_file(filename, shapes, lod_levels, dtypes, pass_num=1, for_parallel=True):
+    """Reader over a recordio file written by recordio_writer (csrc/recordio
+    or the python fallback)."""
+    from .. import recordio_io
+
+    r = py_reader(capacity=64, shapes=shapes, dtypes=dtypes, lod_levels=lod_levels)
+    r.decorate_paddle_reader(lambda: recordio_io.read_batches(filename, shapes, dtypes, pass_num))
+    return r
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1, buffer_size=None, pass_num=1):
+    from .. import recordio_io
+
+    r = py_reader(capacity=buffer_size or 64, shapes=shapes, dtypes=dtypes, lod_levels=lod_levels)
+
+    def gen():
+        for f in filenames:
+            yield from recordio_io.read_batches(f, shapes, dtypes, pass_num)
+
+    r.decorate_paddle_reader(gen)
+    return r

@@ -1,0 +1,15 @@
+"""Sequence layers over the padded+lengths ragged representation.
+
+Reference: the sequence_* / dynamic_* layers in python/paddle/fluid/layers/nn.py
+backed by LoDTensor kernels (paddle/fluid/operators/sequence_*, lstm_op,
+gru_op, warpctc_op, linear_chain_crf_op...).  TPU-native design: every
+sequence is [batch, max_len, ...] + int32 lengths; recurrences are
+``lax.scan`` over the time axis with mask-gated state updates — static
+shapes, MXU-sized matmuls, no per-sequence dynamic dispatch.
+
+This module is populated in the sequence phase of the build; the full set of
+layer functions lives here so `fluid.layers.dynamic_lstm` etc. resolve.
+"""
+from __future__ import annotations
+
+__all__ = []

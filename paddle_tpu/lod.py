@@ -1,0 +1,89 @@
+"""TPU-native ragged-sequence representation.
+
+The reference's LoDTensor (paddle/fluid/framework/lod_tensor.h) stores
+variable-length sequences concatenated along dim 0 plus a level-of-detail
+offset table.  That layout forces dynamic shapes, which XLA cannot tile onto
+the MXU.  Here a batch of ragged sequences is a *dense padded* array
+``[batch, max_len, ...]`` plus an int32 ``lengths[batch]`` vector; nested LoD
+(lod_level=2, e.g. paragraphs of sentences) adds a second lengths array.  All
+sequence ops are mask-aware.  ``LoDArray`` is the host-side container the
+DataFeeder produces and the Executor feeds as two device arrays
+(``name`` and ``name@LENGTHS``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LoDArray", "create_lod_array", "pack_sequences", "unpack_sequences"]
+
+
+class LoDArray:
+    """Host container: padded data + lengths (+ optional nested lengths)."""
+
+    def __init__(self, data: np.ndarray, lengths: np.ndarray, sub_lengths: np.ndarray | None = None):
+        self.data = np.asarray(data)
+        self.lengths = np.asarray(lengths, dtype=np.int32)
+        self.sub_lengths = None if sub_lengths is None else np.asarray(sub_lengths, dtype=np.int32)
+        if self.data.shape[0] != self.lengths.shape[0]:
+            raise ValueError("batch dims disagree: data %s vs lengths %s" % (self.data.shape, self.lengths.shape))
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def lod_level(self):
+        return 1 if self.sub_lengths is None else 2
+
+    def recursive_sequence_lengths(self):
+        lens = [self.lengths.tolist()]
+        if self.sub_lengths is not None:
+            lens.append(self.sub_lengths.tolist())
+        return lens
+
+    def __repr__(self):
+        return "LoDArray(shape=%s, dtype=%s, lengths=%s)" % (self.data.shape, self.data.dtype, self.lengths.tolist())
+
+
+def pack_sequences(seqs, pad_value=0, maxlen=None, dtype=None) -> LoDArray:
+    """[array(len_i, ...)] -> LoDArray with padded [batch, max_len, ...]."""
+    seqs = [np.asarray(s) for s in seqs]
+    if dtype is None:
+        dtype = seqs[0].dtype if seqs else np.float32
+    lengths = np.array([len(s) for s in seqs], dtype=np.int32)
+    ml = int(maxlen if maxlen is not None else (lengths.max() if len(seqs) else 0))
+    lengths = np.minimum(lengths, ml)
+    trailing = seqs[0].shape[1:] if seqs else ()
+    out = np.full((len(seqs), ml) + tuple(trailing), pad_value, dtype=dtype)
+    for i, s in enumerate(seqs):
+        L = min(len(s), ml)
+        out[i, :L] = np.asarray(s[:L], dtype=dtype)
+    return LoDArray(out, lengths)
+
+
+def unpack_sequences(lod: LoDArray):
+    """LoDArray -> list of unpadded arrays."""
+    return [np.asarray(lod.data[i, : int(L)]) for i, L in enumerate(lod.lengths)]
+
+
+def create_lod_array(data, recursive_seq_lens=None, place=None) -> LoDArray:
+    """Reference-style constructor (fluid.create_lod_tensor,
+    python/paddle/fluid/lod_tensor.py:24).  Accepts either a list of per-item
+    arrays or a flat concatenated array + recursive_seq_lens."""
+    if isinstance(data, LoDArray):
+        return data
+    if isinstance(data, (list, tuple)) and recursive_seq_lens is None:
+        return pack_sequences(data)
+    data = np.asarray(data)
+    if recursive_seq_lens is None:
+        return LoDArray(data, np.full((data.shape[0],), data.shape[1] if data.ndim > 1 else 1, np.int32))
+    if len(recursive_seq_lens) == 1:
+        lens = recursive_seq_lens[0]
+        offs = np.concatenate([[0], np.cumsum(lens)])
+        seqs = [data[offs[i]: offs[i + 1]] for i in range(len(lens))]
+        return pack_sequences(seqs)
+    raise NotImplementedError("nested lod>1 flat construction; pass per-item lists instead")

@@ -1,0 +1,9 @@
+"""Op lowering rules (the TPU 'kernel library').
+
+Importing this package registers every op's JAX lowering rule
+(reference analog: paddle/fluid/operators/*.cc kernel registrations).
+"""
+from . import tensor_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

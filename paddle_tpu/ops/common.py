@@ -1,0 +1,38 @@
+"""Shared helpers for op lowering rules."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import np_dtype
+
+
+def jnp():
+    import jax.numpy as jnp_
+
+    return jnp_
+
+
+def to_jdtype(dtype):
+    return np_dtype(dtype)
+
+
+def bcast_y(x, y, axis: int):
+    """Reference elementwise broadcast semantics
+    (paddle/fluid/operators/elementwise_op_function.h): ``y``'s shape is
+    aligned to ``x`` starting at ``axis`` (axis=-1 → trailing alignment)."""
+    xs, ys = np.ndim(x), np.ndim(y)
+    if ys == 0 or xs == ys:
+        return y
+    if axis == -1 or axis is None:
+        axis = xs - ys
+    new_shape = (1,) * axis + tuple(np.shape(y)) + (1,) * (xs - axis - ys)
+    return y.reshape(new_shape)
+
+
+def reduce_axes(dim, ndim):
+    """Normalize the reference reduce ops' ``dim`` attr."""
+    if dim is None or dim == [] or dim is False:
+        return tuple(range(ndim))
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % ndim for d in dim)

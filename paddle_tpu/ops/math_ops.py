@@ -1,0 +1,366 @@
+"""Math op lowerings: elementwise (reference broadcast semantics), matmul/mul,
+reductions, activations, compares, logicals.
+
+Reference kernels: paddle/fluid/operators/{elementwise_*,mul,matmul,reduce_*,
+activation,compare,logical,scale,clip,...}_op.*  On TPU every one of these is
+a fusible XLA HLO — there is no per-op kernel launch to optimize, so the rules
+are direct jnp expressions and XLA fuses them into neighboring matmuls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register
+from .common import bcast_y, reduce_axes
+
+# ---------------------------------------------------------------------------
+# elementwise binary with paddle axis-broadcast
+# ---------------------------------------------------------------------------
+
+_BINOPS = {
+    "elementwise_add": lambda x, y: x + y,
+    "elementwise_sub": lambda x, y: x - y,
+    "elementwise_mul": lambda x, y: x * y,
+    "elementwise_div": lambda x, y: x / y,
+    "elementwise_max": lambda x, y: _jnp().maximum(x, y),
+    "elementwise_min": lambda x, y: _jnp().minimum(x, y),
+    "elementwise_pow": lambda x, y: x**y,
+    "elementwise_mod": lambda x, y: x % y,
+    "elementwise_floordiv": lambda x, y: x // y,
+}
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _make_binop(op_type, fn):
+    @register(op_type)
+    def _rule(ctx, op, fn=fn):
+        x = ctx.get_input(op, "X")
+        y = ctx.get_input(op, "Y")
+        y = bcast_y(x, y, op.attrs.get("axis", -1))
+        ctx.set_output(op, "Out", fn(x, y))
+
+
+for _t, _f in _BINOPS.items():
+    _make_binop(_t, _f)
+
+
+@register("scale")
+def _scale(ctx, op):
+    x = ctx.get_input(op, "X")
+    s = op.attrs.get("scale", 1.0)
+    b = op.attrs.get("bias", 0.0)
+    if op.attrs.get("bias_after_scale", True):
+        ctx.set_output(op, "Out", x * s + b)
+    else:
+        ctx.set_output(op, "Out", (x + b) * s)
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+@register("mul")
+def _mul(ctx, op):
+    """x flattened at x_num_col_dims @ y flattened at y_num_col_dims
+    (reference operators/mul_op.cc).  This is the MXU workhorse; accumulate in
+    f32 regardless of input dtype."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    xn = op.attrs.get("x_num_col_dims", 1)
+    yn = op.attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), -1))
+    y2 = y.reshape((int(np.prod(ys[:yn])), -1))
+    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    ctx.set_output(op, "Out", out.reshape(tuple(xs[:xn]) + tuple(ys[yn:])))
+
+
+@register("matmul")
+def _matmul(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    tx, ty = op.attrs.get("transpose_X", False), op.attrs.get("transpose_Y", False)
+    alpha = op.attrs.get("alpha", 1.0)
+    x_was_1d = x.ndim == 1
+    y_was_1d = y.ndim == 1
+    if x_was_1d:
+        x = x[None, :]
+    if y_was_1d:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    if alpha != 1.0:
+        out = out * alpha
+    # strip only the dims we appended, never genuine size-1 batch dims
+    if y_was_1d:
+        out = out.reshape(out.shape[:-1])
+    if x_was_1d:
+        out = out.reshape(out.shape[:-2] + out.shape[-1:])
+    if x_was_1d and y_was_1d and out.ndim == 0:
+        out = out.reshape(1)
+    ctx.set_output(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _make_reduce(op_type, jfn):
+    @register(op_type)
+    def _rule(ctx, op, jfn=jfn):
+        x = ctx.get_input(op, "X")
+        if op.attrs.get("reduce_all", False):
+            axes = tuple(range(x.ndim))
+        else:
+            axes = reduce_axes(op.attrs.get("dim"), x.ndim)
+        out = jfn(x, axes, op.attrs.get("keep_dim", False))
+        ctx.set_output(op, "Out", out)
+
+
+_make_reduce("reduce_sum", lambda x, a, k: _jnp().sum(x, axis=a, keepdims=k))
+_make_reduce("reduce_mean", lambda x, a, k: _jnp().mean(x, axis=a, keepdims=k))
+_make_reduce("reduce_max", lambda x, a, k: _jnp().max(x, axis=a, keepdims=k))
+_make_reduce("reduce_min", lambda x, a, k: _jnp().min(x, axis=a, keepdims=k))
+_make_reduce("reduce_prod", lambda x, a, k: _jnp().prod(x, axis=a, keepdims=k))
+
+
+@register("mean")
+def _mean(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.mean(ctx.get_input(op, "X")).reshape((1,)))
+
+
+# ---------------------------------------------------------------------------
+# activations (reference operators/activation_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _make_act(op_type, fn):
+    @register(op_type)
+    def _rule(ctx, op, fn=fn):
+        x = ctx.get_input(op, "X")
+        ctx.set_output(op, "Out", fn(x, op.attrs))
+        ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+def _jn():
+    import jax.nn
+
+    return jax.nn
+
+
+_ACTS = {
+    "relu": lambda x, a: _jn().relu(x),
+    "relu6": lambda x, a: _jnp().clip(x, 0, a.get("threshold", 6.0)),
+    "leaky_relu": lambda x, a: _jn().leaky_relu(x, a.get("alpha", 0.02)),
+    "elu": lambda x, a: _jn().elu(x, a.get("alpha", 1.0)),
+    "brelu": lambda x, a: _jnp().clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: _jnp().log1p(_jnp().exp(_jnp().clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))),
+    "sigmoid": lambda x, a: _jn().sigmoid(x),
+    "logsigmoid": lambda x, a: _jn().log_sigmoid(x),
+    "tanh": lambda x, a: _jnp().tanh(x),
+    "tanh_shrink": lambda x, a: x - _jnp().tanh(x),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * _jnp().tanh(x * a.get("scale_a", 2.0 / 3.0)),
+    "hard_sigmoid": lambda x, a: _jnp().clip(x * a.get("slope", 0.2) + a.get("offset", 0.5), 0.0, 1.0),
+    "swish": lambda x, a: x * _jn().sigmoid(a.get("beta", 1.0) * x),
+    "softplus": lambda x, a: _jn().softplus(x),
+    "softsign": lambda x, a: x / (1 + _jnp().abs(x)),
+    "softshrink": lambda x, a: _jnp().sign(x) * _jnp().maximum(_jnp().abs(x) - a.get("lambda", 0.5), 0.0),
+    "hard_shrink": lambda x, a: _jnp().where(_jnp().abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "thresholded_relu": lambda x, a: _jnp().where(x > a.get("threshold", 1.0), x, 0.0),
+    "abs": lambda x, a: _jnp().abs(x),
+    "ceil": lambda x, a: _jnp().ceil(x),
+    "floor": lambda x, a: _jnp().floor(x),
+    "cos": lambda x, a: _jnp().cos(x),
+    "sin": lambda x, a: _jnp().sin(x),
+    "round": lambda x, a: _jnp().round(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "square": lambda x, a: x * x,
+    "exp": lambda x, a: _jnp().exp(x),
+    "sqrt": lambda x, a: _jnp().sqrt(x),
+    "rsqrt": lambda x, a: 1.0 / _jnp().sqrt(x),
+    "log": lambda x, a: _jnp().log(x),
+    "pow": lambda x, a: x ** a.get("factor", 1.0),
+}
+
+for _t, _f in _ACTS.items():
+    _make_act(_t, _f)
+
+
+@register("prelu")
+def _prelu(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    alpha = ctx.get_input(op, "Alpha")
+    mode = op.attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "all":
+        alpha = alpha.reshape(())
+    ctx.set_output(op, "Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register("maxout")
+def _maxout(ctx, op):
+    x = ctx.get_input(op, "X")  # NCHW
+    g = op.attrs["groups"]
+    n, c, h, w = x.shape
+    ctx.set_output(op, "Out", x.reshape(n, c // g, g, h, w).max(axis=2))
+
+
+@register("clip")
+def _clip(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.clip(ctx.get_input(op, "X"), op.attrs["min"], op.attrs["max"]))
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    mn = op.attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    scale = jnp.where(norm > mn, mn / jnp.maximum(norm, 1e-12), 1.0)
+    ctx.set_output(op, "Out", (x * scale).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# compares & logicals
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    "less_than": lambda x, y: x < y,
+    "less_equal": lambda x, y: x <= y,
+    "greater_than": lambda x, y: x > y,
+    "greater_equal": lambda x, y: x >= y,
+    "equal": lambda x, y: x == y,
+    "not_equal": lambda x, y: x != y,
+}
+
+
+def _make_cmp(op_type, fn):
+    @register(op_type)
+    def _rule(ctx, op, fn=fn):
+        x = ctx.get_input(op, "X")
+        y = ctx.get_input(op, "Y")
+        ctx.set_output(op, "Out", fn(x, y))
+
+
+for _t, _f in _CMP.items():
+    _make_cmp(_t, _f)
+
+_LOGICAL = {
+    "logical_and": lambda x, y: x & y,
+    "logical_or": lambda x, y: x | y,
+    "logical_xor": lambda x, y: x ^ y,
+}
+
+
+def _make_logical(op_type, fn):
+    @register(op_type)
+    def _rule(ctx, op, fn=fn):
+        x = ctx.get_input(op, "X").astype(bool)
+        y = ctx.get_input(op, "Y").astype(bool)
+        ctx.set_output(op, "Out", fn(x, y))
+
+
+for _t, _f in _LOGICAL.items():
+    _make_logical(_t, _f)
+
+
+@register("logical_not")
+def _logical_not(ctx, op):
+    ctx.set_output(op, "Out", ~ctx.get_input(op, "X").astype(bool))
+
+
+# ---------------------------------------------------------------------------
+# misc math
+# ---------------------------------------------------------------------------
+
+
+@register("cos_sim")
+def _cos_sim(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "XNorm", xn)
+    ctx.set_output(op, "YNorm", yn)
+
+
+@register("norm")
+def _norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attrs.get("axis", -1)
+    eps = op.attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    ctx.set_output(op, "Out", x / norm)
+    ctx.set_output(op, "Norm", norm)
+
+
+@register("sign")
+def _sign(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.sign(ctx.get_input(op, "X")))
+
+
+@register("cumsum")
+def _cumsum(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attrs.get("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if op.attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if op.attrs.get("exclusive", False):
+        out = out - x
+    ctx.set_output(op, "Out", out)
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # [b, m]
+    y = ctx.get_input(op, "Y")  # [b, n]
+    w = ctx.get_input(op, "Weight")  # [size, m, n]
+    out = jnp.einsum("bm,smn,bn->bs", x, w, y)
+    b = ctx.get_input(op, "Bias")
+    if b is not None:
+        out = out + b
+    ctx.set_output(op, "Out", out)
+
+
+@register("conv_shift")
+def _conv_shift(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # [b, m]
+    y = ctx.get_input(op, "Y")  # [b, n], n odd, n <= m
+    b, m = x.shape
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    ctx.set_output(op, "Out", jnp.einsum("bmn,bn->bm", x[:, idx], y))

@@ -1,0 +1,450 @@
+"""Tensor creation / manipulation op lowerings.
+
+Reference kernels: paddle/fluid/operators/{fill_constant,assign,cast,concat,
+split,reshape,transpose,stack,unstack,expand,squeeze,unsqueeze,slice,shape,
+gather,scatter,pad,reverse,arg_min_max,argsort,top_k,one_hot,...}_op.*
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register
+from .common import to_jdtype
+
+
+@register("fill_constant")
+def _fill_constant(ctx, op):
+    import jax.numpy as jnp
+
+    a = op.attrs
+    out = jnp.full(tuple(int(s) for s in a["shape"]), a["value"], dtype=to_jdtype(a["dtype"]))
+    ctx.set_output(op, "Out", out)
+
+
+@register("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, op):
+    import jax.numpy as jnp
+
+    a = op.attrs
+    ref = ctx.get_input(op, "Input")
+    shape = [int(s) for s in a["shape"]]
+    shape[a.get("output_dim_idx", 0)] = ref.shape[a.get("input_dim_idx", 0)]
+    ctx.set_output(op, "Out", jnp.full(tuple(shape), a["value"], dtype=to_jdtype(a["dtype"])))
+
+
+@register("fill_zeros_like")
+def _fill_zeros_like(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.zeros_like(ctx.get_input(op, "X")))
+
+
+@register("assign")
+def _assign(ctx, op):
+    ctx.set_output(op, "Out", ctx.get_input(op, "X"))
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+@register("assign_value")
+def _assign_value(ctx, op):
+    import jax.numpy as jnp
+
+    vals = np.asarray(op.attrs["values"])
+    ctx.set_output(op, "Out", jnp.asarray(vals, dtype=to_jdtype(op.attrs["dtype"])))
+
+
+@register("cast")
+def _cast(ctx, op):
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", x.astype(to_jdtype(op.attrs["out_dtype"])))
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+@register("concat")
+def _concat(ctx, op):
+    import jax.numpy as jnp
+
+    xs = ctx.get_inputs(op, "X")
+    ctx.set_output(op, "Out", jnp.concatenate(xs, axis=op.attrs.get("axis", 0)))
+
+
+@register("split")
+def _split(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attrs.get("axis", -1)
+    sections = op.attrs.get("sections")
+    num = op.attrs.get("num", 0)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    ctx.set_outputs(op, "Out", outs)
+
+
+@register("reshape", "reshape2")
+def _reshape(ctx, op):
+    x = ctx.get_input(op, "X")
+    shape = list(op.attrs["shape"])
+    # reference semantics: 0 = copy input dim, -1 = infer
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    ctx.set_output(op, "Out", x.reshape(tuple(shape)))
+
+
+@register("squeeze", "squeeze2")
+def _squeeze(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axes = op.attrs.get("axes") or [i for i, s in enumerate(x.shape) if s == 1]
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    ctx.set_output(op, "Out", jnp.squeeze(x, axis=axes))
+
+
+@register("unsqueeze", "unsqueeze2")
+def _unsqueeze(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    out = x
+    for a in sorted(op.attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    ctx.set_output(op, "Out", out)
+
+
+@register("transpose", "transpose2")
+def _transpose(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.transpose(ctx.get_input(op, "X"), op.attrs["axis"]))
+
+
+@register("flatten")
+def _flatten(ctx, op):
+    x = ctx.get_input(op, "X")
+    ax = op.attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    ctx.set_output(op, "Out", x.reshape((lead, -1)))
+
+
+@register("stack")
+def _stack(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Y", jnp.stack(ctx.get_inputs(op, "X"), axis=op.attrs.get("axis", 0)))
+
+
+@register("unstack")
+def _unstack(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attrs.get("axis", 0)
+    outs = [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+    ctx.set_outputs(op, "Y", outs)
+
+
+@register("expand")
+def _expand(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.tile(ctx.get_input(op, "X"), op.attrs["expand_times"]))
+
+
+@register("slice")
+def _slice(ctx, op):
+    x = ctx.get_input(op, "X")
+    axes, starts, ends = op.attrs["axes"], op.attrs["starts"], op.attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    ctx.set_output(op, "Out", x[tuple(idx)])
+
+
+@register("shape")
+def _shape(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.asarray(np.array(np.shape(ctx.get_input(op, "Input")), dtype=np.int32)))
+
+
+@register("gather")
+def _gather(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Index")
+    ctx.set_output(op, "Out", jnp.take(x, idx.reshape(-1), axis=0))
+
+
+@register("scatter")
+def _scatter(ctx, op):
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Ids")
+    upd = ctx.get_input(op, "Updates")
+    ctx.set_output(op, "Out", x.at[idx.reshape(-1)].set(upd))
+
+
+@register("pad")
+def _pad(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    p = op.attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output(op, "Out", jnp.pad(x, pads, constant_values=op.attrs.get("pad_value", 0.0)))
+
+
+@register("pad2d")
+def _pad2d(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    t, b, l, r = op.attrs["paddings"]
+    mode = op.attrs.get("mode", "constant")
+    pads = [(0, 0), (0, 0), (t, b), (l, r)]
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=op.attrs.get("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pads, mode="reflect")
+    else:
+        out = jnp.pad(x, pads, mode="edge")
+    ctx.set_output(op, "Out", out)
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # larger
+    y = ctx.get_input(op, "Y")
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    ctx.set_output(op, "Out", jnp.pad(y, pads, constant_values=op.attrs.get("pad_value", 0.0)))
+
+
+@register("crop")
+def _crop(ctx, op):
+    x = ctx.get_input(op, "X")
+    offsets = op.attrs.get("offsets") or [0] * x.ndim
+    shape = op.attrs.get("shape")
+    if shape is None:
+        shape = np.shape(ctx.get_input(op, "Y"))
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output(op, "Out", x[idx])
+
+
+@register("reverse")
+def _reverse(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axes = op.attrs["axis"]
+    if isinstance(axes, int):
+        axes = [axes]
+    ctx.set_output(op, "Out", jnp.flip(x, axis=tuple(axes)))
+
+
+@register("multiplex")
+def _multiplex(ctx, op):
+    import jax.numpy as jnp
+
+    xs = jnp.stack(ctx.get_inputs(op, "X"), axis=0)  # [k, n, d]
+    ids = ctx.get_input(op, "Ids").reshape(-1).astype("int32")  # [n]
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output(op, "Out", xs[ids, rows])
+
+
+@register("arg_max")
+def _argmax(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.argmax(ctx.get_input(op, "X"), axis=op.attrs.get("axis", 0)).astype("int64"))
+
+
+@register("arg_min")
+def _argmin(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.argmin(ctx.get_input(op, "X"), axis=op.attrs.get("axis", 0)).astype("int64"))
+
+
+@register("argsort")
+def _argsort(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.set_output(op, "Indices", idx.astype("int64"))
+    ctx.set_output(op, "Out", jnp.take_along_axis(x, idx, axis=axis))
+
+
+@register("top_k")
+def _top_k(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    vals, idx = jax.lax.top_k(x, op.attrs["k"])
+    ctx.set_output(op, "Out", vals)
+    ctx.set_output(op, "Indices", idx.astype("int64"))
+
+
+@register("one_hot")
+def _one_hot(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    depth = op.attrs["depth"]
+    flat = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    ctx.set_output(op, "Out", jax.nn.one_hot(flat, depth, dtype="float32"))
+
+
+@register("uniform_random", "uniform_random_batch_size_like")
+def _uniform_random(ctx, op):
+    import jax
+
+    a = op.attrs
+    shape = [int(s) for s in a["shape"]]
+    if op.inputs.get("Input"):
+        ref = ctx.get_input(op, "Input")
+        shape[a.get("output_dim_idx", 0)] = ref.shape[a.get("input_dim_idx", 0)]
+    key = ctx.op_key(op, a.get("seed", 0))
+    out = jax.random.uniform(
+        key, tuple(shape), dtype=to_jdtype(a.get("dtype", "float32")),
+        minval=a.get("min", -1.0), maxval=a.get("max", 1.0),
+    )
+    ctx.set_output(op, "Out", out)
+
+
+@register("gaussian_random", "gaussian_random_batch_size_like")
+def _gaussian_random(ctx, op):
+    import jax
+
+    a = op.attrs
+    shape = [int(s) for s in a["shape"]]
+    if op.inputs.get("Input"):
+        ref = ctx.get_input(op, "Input")
+        shape[a.get("output_dim_idx", 0)] = ref.shape[a.get("input_dim_idx", 0)]
+    key = ctx.op_key(op, a.get("seed", 0))
+    out = jax.random.normal(key, tuple(shape), dtype=to_jdtype(a.get("dtype", "float32")))
+    ctx.set_output(op, "Out", out * a.get("std", 1.0) + a.get("mean", 0.0))
+
+
+@register("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, op):
+    import jax
+
+    a = op.attrs
+    key = ctx.op_key(op, a.get("seed", 0))
+    out = jax.random.truncated_normal(
+        key, -2.0, 2.0, tuple(int(s) for s in a["shape"]), dtype=to_jdtype(a.get("dtype", "float32"))
+    )
+    ctx.set_output(op, "Out", out * a.get("std", 1.0) + a.get("mean", 0.0))
+
+
+@register("sampling_id")
+def _sampling_id(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")  # [batch, k] probabilities
+    key = ctx.op_key(op, op.attrs.get("seed", 0))
+    ids = jax.random.categorical(key, jax.numpy.log(x + 1e-20), axis=-1)
+    ctx.set_output(op, "Out", ids.astype("int64"))
+
+
+@register("random_crop")
+def _random_crop(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    shape = op.attrs["shape"]  # crop shape for trailing dims
+    key = ctx.op_key(op, op.attrs.get("seed", 0))
+    lead = x.ndim - len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        key, sub = jax.random.split(key)
+        hi = x.shape[lead + i] - s
+        starts.append(jax.random.randint(sub, (), 0, hi + 1) if hi > 0 else 0)
+    idx = tuple([slice(None)] * lead)
+    out = jax.lax.dynamic_slice(
+        x, tuple([0] * lead) + tuple(starts), tuple(x.shape[:lead]) + tuple(shape)
+    )
+    del idx
+    ctx.set_output(op, "Out", out)
+
+
+@register("sum", "sums")
+def _sum(ctx, op):
+    xs = ctx.get_inputs(op, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output(op, "Out", out)
+
+
+@register("has_inf")
+def _has_inf(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.isinf(ctx.get_input(op, "X")).any().reshape(1))
+
+
+@register("has_nan")
+def _has_nan(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.isnan(ctx.get_input(op, "X")).any().reshape(1))
+
+
+@register("isfinite")
+def _isfinite(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.isfinite(ctx.get_input(op, "X")).all().reshape(1))
+
+
+@register("increment")
+def _increment(ctx, op):
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", x + np.asarray(op.attrs.get("step", 1.0)).astype(np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype))
+
+
+@register("print")
+def _print(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "In")
+    msg = op.attrs.get("message", "")
+    jax.debug.print(msg + " {}", x)
+    ctx.set_output(op, "Out", x)
+
+
+@register("label_smooth")
+def _label_smooth(ctx, op):
+    x = ctx.get_input(op, "X")
+    eps = op.attrs.get("epsilon", 0.1)
+    prior = ctx.get_input(op, "PriorDist")
+    k = x.shape[-1]
+    if prior is None:
+        out = (1.0 - eps) * x + eps / k
+    else:
+        out = (1.0 - eps) * x + eps * prior
+    ctx.set_output(op, "Out", out)
+
+
+@register("piecewise_decay")
+def _piecewise_decay(ctx, op):
+    import jax.numpy as jnp
+
+    step = ctx.get_input(op, "Step").reshape(())
+    boundaries = jnp.asarray(op.attrs["boundaries"], dtype="float32")
+    values = jnp.asarray(op.attrs["values"], dtype="float32")
+    idx = jnp.sum((step >= boundaries).astype("int32"))
+    ctx.set_output(op, "Out", values[idx].reshape(1))

@@ -1,0 +1,99 @@
+"""ParallelExecutor: data-parallel execution over the TPU mesh.
+
+Reference: python/paddle/fluid/parallel_executor.py +
+paddle/fluid/framework/details/* (SSA graph, NCCL all-reduce).  The reference
+replicates the graph per GPU and inserts NCCL all-reduce ops on gradients.
+On TPU none of that machinery is needed: the SAME traced step function is
+jitted with a ``jax.sharding.Mesh`` over all devices, feeds carry
+batch-sharded ``NamedSharding``s, parameters are replicated, and XLA's SPMD
+partitioner inserts the gradient all-reduce (psum over ICI) automatically.
+So "build strategy" reduces to sharding annotations — the collectives ride
+ICI with no user-visible communication code.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .executor import Executor, global_scope
+from .framework import default_main_program, Variable
+
+__all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
+
+
+class ExecutionStrategy:
+    """Kept for API parity; knobs map to jit options or are no-ops under XLA
+    whole-program scheduling."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_data_balance = False
+
+
+class ParallelExecutor:
+    def __init__(
+        self,
+        use_cuda=None,
+        loss_name=None,
+        main_program=None,
+        share_vars_from=None,
+        exec_strategy=None,
+        build_strategy=None,
+        num_trainers=1,
+        trainer_id=0,
+        scope=None,
+        use_tpu=True,
+        devices=None,
+    ):
+        import jax
+        from jax.sharding import Mesh
+
+        self._program = main_program or default_main_program()
+        self._loss_name = loss_name
+        self._scope = scope or global_scope()
+        devs = devices if devices is not None else jax.devices()
+        self._mesh = Mesh(np.array(devs), ("dp",))
+        self._exe = Executor()
+        self._exe._mesh = self._mesh
+        if share_vars_from is not None:
+            self._scope = share_vars_from._scope
+
+    @property
+    def device_count(self):
+        return self._mesh.devices.size
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        if isinstance(feed, list):
+            # reference accepted per-device feed lists; concatenate on batch
+            merged = {}
+            for d in feed:
+                for k, v in d.items():
+                    merged.setdefault(k, []).append(np.asarray(v))
+            feed = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
+        fetch_list = [f.name if isinstance(f, Variable) else f for f in (fetch_list or [])]
+        return self._exe.run(
+            self._program,
+            feed=feed,
+            fetch_list=fetch_list,
+            scope=self._scope,
+            return_numpy=return_numpy,
+        )

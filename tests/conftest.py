@@ -1,0 +1,30 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax is imported, so
+multi-device sharding tests run without TPU hardware."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_namespace():
+    """Each test gets a fresh unique_name namespace and default programs."""
+    import paddle_tpu.unique_name as un
+    from paddle_tpu import framework
+
+    old_gen = un.switch()
+    old_main = framework.switch_main_program(framework.Program())
+    old_startup = framework.switch_startup_program(framework.Program())
+    yield
+    un.switch(old_gen)
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)

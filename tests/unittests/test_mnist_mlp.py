@@ -1,0 +1,65 @@
+"""End-to-end: build program, append_backward via optimizer, run, converge.
+
+Mirrors the reference's book/test_recognize_digits MLP path.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _make_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 784).astype("float32")
+    w = rng.randn(784, 10).astype("float32")
+    logits = x @ w
+    y = np.argmax(logits, axis=1).astype("int64").reshape(n, 1)
+    return x, y
+
+
+def test_mlp_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        hidden = fluid.layers.fc(input=img, size=64, act="relu")
+        prediction = fluid.layers.fc(input=hidden, size=10, act="softmax")
+        loss = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        opt = fluid.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(avg_loss)
+
+    x, y = _make_data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = None
+        last = None
+        for i in range(200):
+            lv, av = exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_loss, acc])
+            if first is None:
+                first = float(lv[0])
+            last = float(lv[0])
+        assert last < first * 0.5, (first, last)
+        assert float(av[0]) > 0.7
+
+
+def test_executor_caches_compilation():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.zeros((3, 4), "float32")}, fetch_list=[y])
+        n_cached = len(exe._cache)
+        exe.run(main, feed={"x": np.ones((3, 4), "float32")}, fetch_list=[y])
+        assert len(exe._cache) == n_cached  # same shapes -> same executable
+        exe.run(main, feed={"x": np.ones((5, 4), "float32")}, fetch_list=[y])
+        assert len(exe._cache) == n_cached + 1  # new batch size -> recompile

@@ -1,0 +1,93 @@
+"""Program transforms: clone(for_test), prune, serialization, op roles."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.5)
+        pred = fluid.layers.fc(input=h, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    return main, startup, loss, pred
+
+
+def test_clone_for_test_prunes_optimizer_ops():
+    main, startup, loss, pred = _build()
+    test_prog = main.clone(for_test=True)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "sgd" not in types
+    assert "backward" not in types
+    # dropout flipped to is_test
+    for op in test_prog.global_block().ops:
+        if op.type == "dropout":
+            assert op.attrs["is_test"] is True
+    # the test clone runs without feeds of grads and does NOT mutate params
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w_before = np.asarray(fluid.global_scope()["fc_0.w_0"]).copy()
+        x = np.random.randn(4, 4).astype("float32")
+        y = np.zeros((4, 1), "int64")
+        exe.run(test_prog, feed={"x": x, "label": y}, fetch_list=[loss])
+        w_after = np.asarray(fluid.global_scope()["fc_0.w_0"])
+        assert np.array_equal(w_before, w_after)
+
+
+def test_train_then_eval_clone_after_minimize():
+    main, startup, loss, pred = _build()
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype("float32")
+    y = (x[:, 0] > 0).astype("int64").reshape(-1, 1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(50):
+            exe.run(main, feed={"x": x, "label": y}, fetch_list=[loss])
+        (lv,) = exe.run(test_prog, feed={"x": x, "label": y}, fetch_list=[loss])
+        assert float(lv[0]) < 0.6
+
+
+def test_prune_and_serialize_roundtrip():
+    main, startup, loss, pred = _build()
+    inf = main.prune([pred])
+    types = [op.type for op in inf.global_block().ops]
+    assert "sgd" not in types and "backward" not in types
+    d = inf.to_dict()
+    back = fluid.Program.from_dict(d)
+    assert [op.type for op in back.global_block().ops] == types
+
+
+def test_math_op_patch_pow_and_matmul_1d():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        p = x ** 2.0
+        v = fluid.layers.data(name="v", shape=[4], dtype="float32", append_batch_size=False)
+        m = fluid.layers.data(name="m", shape=[1, 2, 4], dtype="float32", append_batch_size=False)
+        mv = fluid.layers.matmul(m, v)  # [1,2,4] @ [4] -> [1,2]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        outs = exe.run(
+            main,
+            feed={
+                "x": np.arange(6).reshape(2, 3).astype("float32"),
+                "v": np.ones(4, "float32"),
+                "m": np.ones((1, 2, 4), "float32"),
+            },
+            fetch_list=[p, mv],
+        )
+    np.testing.assert_allclose(outs[0], np.arange(6).reshape(2, 3).astype("float32") ** 2)
+    assert outs[1].shape == (1, 2)
