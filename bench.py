@@ -1,0 +1,82 @@
+"""Headline benchmark: ResNet-50 ImageNet training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N, "unit": "images/sec", "vs_baseline": R}
+
+Baseline: the reference (PaddlePaddle Fluid 0.15) published ~340 images/sec
+on a V100 for ResNet-50 batch 128 fp32 (benchmark/fluid, best configuration);
+vs_baseline = ours / 340.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 340.0
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.jax_bridge import init_state, program_to_fn
+    from paddle_tpu.models import resnet
+
+    on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d) for d in jax.devices())
+    batch = 128 if on_tpu else 8
+    dtype = "bfloat16" if on_tpu else "float32"
+    image_shape = (3, 224, 224)
+
+    with fluid.unique_name.guard():
+        model = resnet.get_model(
+            batch_size=batch, class_dim=1000, depth=50, image_shape=image_shape, lr=0.1,
+            dtype=dtype,
+        )
+    state = init_state(model["startup"])
+    step = program_to_fn(model["main"], [model["loss"]], return_state=True)
+    jitted = jax.jit(step, donate_argnums=(0,))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, *image_shape).astype(np.float32)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x, dtype=jnp.bfloat16)
+    y = rng.randint(0, 1000, size=(batch, 1)).astype(np.int64)
+    x = jax.device_put(x)
+    y = jax.device_put(y)
+    feeds = {"data": x, "label": y}
+
+    # warmup: first steps may recompile as donated buffer layouts settle
+    for _ in range(3):
+        fetches, state = jitted(state, feeds)
+    np.asarray(fetches[0])
+
+    iters = 30 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fetches, state = jitted(state, feeds)
+    np.asarray(fetches[0])  # device->host read: true sync even through the tunnel
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Bridge: lower a Program to a plain jittable JAX function.
+
+Used by __graft_entry__ / bench / external JAX interop: given a Program and
+fetch targets, returns ``fn(state_dict, feed_dict) -> [fetches]`` suitable
+for jax.jit / pjit with custom shardings.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .executor import LoweringContext, lower_block
+from .framework import Program, Variable
+
+__all__ = ["program_to_fn", "init_state"]
+
+
+def program_to_fn(program: Program, fetch_list, is_test=False, return_state=False):
+    fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in fetch_list]
+    persistable = {v.name for v in program.list_vars() if v.persistable}
+
+    def fn(state, feeds, rng_key=None):
+        import jax
+
+        key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+        env = {}
+        env.update(state)
+        env.update(feeds)
+        ctx = LoweringContext(program, env, key, is_test=is_test)
+        lower_block(ctx, program.global_block())
+        fetches = [env[n] for n in fetch_names]
+        if return_state:
+            new_state = {n: v for n, v in env.items() if n in persistable}
+            return fetches, new_state
+        return fetches
+
+    return fn
+
+
+def init_state(startup_program: Program, seed=0):
+    """Run the startup program eagerly (host-side trace + jit once) and
+    return the initialized persistable state dict."""
+    import jax
+
+    env = {}
+    ctx = LoweringContext(startup_program, env, jax.random.PRNGKey(seed))
+    lower_block(ctx, startup_program.global_block())
+    persistable = {v.name for v in startup_program.list_vars() if v.persistable}
+    return {n: v for n, v in env.items() if n in persistable}

@@ -309,7 +309,22 @@ def pool2d(
     ksize = pool_size if isinstance(pool_size, (list, tuple)) else [pool_size] * 2
     stride = pool_stride if isinstance(pool_stride, (list, tuple)) else [pool_stride] * 2
     padding = pool_padding if isinstance(pool_padding, (list, tuple)) else [pool_padding] * 2
-    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_shape = None
+    if input.shape is not None:
+        if global_pooling:
+            out_shape = [input.shape[0], input.shape[1], 1, 1]
+        else:
+            hw = []
+            for i in range(2):
+                s = input.shape[2 + i]
+                if s is None or s < 0:
+                    hw.append(-1)
+                elif ceil_mode:
+                    hw.append((s - ksize[i] + 2 * padding[i] + stride[i] - 1) // stride[i] + 1)
+                else:
+                    hw.append((s - ksize[i] + 2 * padding[i]) // stride[i] + 1)
+            out_shape = [input.shape[0], input.shape[1]] + hw
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=out_shape)
     helper.append_op(
         type="pool2d",
         inputs={"X": [input]},

@@ -1,0 +1,10 @@
+"""Model zoo (reference: benchmark/fluid/models/*).
+
+Each model module exposes the reference's builder signature: a function that
+constructs the program (layers only — training wiring is up to the caller)
+plus a ``get_model``-style helper used by bench.py.
+"""
+from . import mnist  # noqa: F401
+from . import vgg  # noqa: F401
+from . import resnet  # noqa: F401
+from . import se_resnext  # noqa: F401

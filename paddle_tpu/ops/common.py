@@ -36,3 +36,19 @@ def reduce_axes(dim, ndim):
     if isinstance(dim, int):
         dim = [dim]
     return tuple(d % ndim for d in dim)
+
+
+def mixed_dtypes(x, y):
+    """bf16 mixed precision: if both operands are floats of different widths,
+    compute in the lower precision (f32 master weights cast to bf16 at the
+    use site — the TPU recipe; the MXU accumulates bf16 dots in f32 in
+    hardware).  Non-float operands are left to JAX type promotion."""
+    if x.dtype == y.dtype:
+        return x, y
+    order = {"bfloat16": 0, "float16": 0, "float32": 1, "float64": 2}
+    dx = order.get(str(x.dtype))
+    dy = order.get(str(y.dtype))
+    if dx is None or dy is None:
+        return x, y  # int/bool operands: let JAX promote correctly
+    target = x.dtype if dx <= dy else y.dtype
+    return x.astype(target), y.astype(target)

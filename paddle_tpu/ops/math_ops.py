@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import register
-from .common import bcast_y, reduce_axes
+from .common import bcast_y, mixed_dtypes, reduce_axes
 
 # ---------------------------------------------------------------------------
 # elementwise binary with paddle axis-broadcast
@@ -41,6 +41,7 @@ def _make_binop(op_type, fn):
     def _rule(ctx, op, fn=fn):
         x = ctx.get_input(op, "X")
         y = ctx.get_input(op, "Y")
+        x, y = mixed_dtypes(x, y)
         y = bcast_y(x, y, op.attrs.get("axis", -1))
         ctx.set_output(op, "Out", fn(x, y))
 
@@ -64,18 +65,19 @@ def _scale(ctx, op):
 @register("mul")
 def _mul(ctx, op):
     """x flattened at x_num_col_dims @ y flattened at y_num_col_dims
-    (reference operators/mul_op.cc).  This is the MXU workhorse; accumulate in
-    f32 regardless of input dtype."""
+    (reference operators/mul_op.cc).  This is the MXU workhorse; accumulation
+    is left to XLA (the TPU MXU accumulates bf16 dots in f32 in hardware)."""
     import jax.numpy as jnp
 
     x = ctx.get_input(op, "X")
     y = ctx.get_input(op, "Y")
+    x, y = mixed_dtypes(x, y)
     xn = op.attrs.get("x_num_col_dims", 1)
     yn = op.attrs.get("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(np.prod(xs[:xn])), -1))
     y2 = y.reshape((int(np.prod(ys[:yn])), -1))
-    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.matmul(x2, y2)
     ctx.set_output(op, "Out", out.reshape(tuple(xs[:xn]) + tuple(ys[yn:])))
 
 
@@ -85,6 +87,7 @@ def _matmul(ctx, op):
 
     x = ctx.get_input(op, "X")
     y = ctx.get_input(op, "Y")
+    x, y = mixed_dtypes(x, y)
     tx, ty = op.attrs.get("transpose_X", False), op.attrs.get("transpose_Y", False)
     alpha = op.attrs.get("alpha", 1.0)
     x_was_1d = x.ndim == 1
@@ -97,7 +100,7 @@ def _matmul(ctx, op):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.matmul(x, y)
     if alpha != 1.0:
         out = out * alpha
     # strip only the dims we appended, never genuine size-1 batch dims

@@ -3,7 +3,7 @@
 Reference kernels: paddle/fluid/operators/{conv,pool,batch_norm,layer_norm,
 softmax,cross_entropy,dropout,lookup_table,lrn,...}_op.* (+ cuDNN variants).
 On TPU the conv/matmul lowerings feed the MXU via lax.conv_general_dilated /
-dot_general with f32 accumulation; everything elementwise around them is left
+dot_general (MXU accumulates bf16 in f32 in hardware); elementwise ops are left
 to XLA fusion, which is what the cuDNN fused kernels hand-coded.
 """
 from __future__ import annotations
@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..registry import register
+from .common import mixed_dtypes
 
 
 def _pair(v, n=2):
@@ -26,6 +27,7 @@ def _conv2d(ctx, op):
 
     x = ctx.get_input(op, "Input")  # NCHW
     w = ctx.get_input(op, "Filter")  # OIHW (I = C/groups)
+    x, w = mixed_dtypes(x, w)
     strides = _pair(op.attrs.get("strides", [1, 1]))
     pads = _pair(op.attrs.get("paddings", [0, 0]))
     dil = _pair(op.attrs.get("dilations", [1, 1]))
@@ -40,7 +42,6 @@ def _conv2d(ctx, op):
         rhs_dilation=dil,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
     ).astype(x.dtype)
     ctx.set_output(op, "Output", out)
 
@@ -52,6 +53,7 @@ def _conv3d(ctx, op):
 
     x = ctx.get_input(op, "Input")  # NCDHW
     w = ctx.get_input(op, "Filter")
+    x, w = mixed_dtypes(x, w)
     strides = _pair(op.attrs.get("strides", [1, 1, 1]), 3)
     pads = _pair(op.attrs.get("paddings", [0, 0, 0]), 3)
     dil = _pair(op.attrs.get("dilations", [1, 1, 1]), 3)
@@ -63,7 +65,6 @@ def _conv3d(ctx, op):
         rhs_dilation=dil,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=op.attrs.get("groups", 1) or 1,
-        preferred_element_type=jnp.float32,
     ).astype(x.dtype)
     ctx.set_output(op, "Output", out)
 
@@ -75,6 +76,7 @@ def _conv2d_transpose(ctx, op):
 
     x = ctx.get_input(op, "Input")  # NCHW
     w = ctx.get_input(op, "Filter")  # [in_c, out_c/groups, kh, kw]
+    x, w = mixed_dtypes(x, w)
     strides = _pair(op.attrs.get("strides", [1, 1]))
     pads = _pair(op.attrs.get("paddings", [0, 0]))
     dil = _pair(op.attrs.get("dilations", [1, 1]))
@@ -99,7 +101,6 @@ def _conv2d_transpose(ctx, op):
         rhs_dilation=dil,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
     ).astype(x.dtype)
     ctx.set_output(op, "Output", out)
 
@@ -122,7 +123,6 @@ def _conv3d_transpose(ctx, op):
         padding=[(k - 1 - p, k - 1 - p) for k, p in zip(ks, pads)],
         lhs_dilation=strides,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        preferred_element_type=jnp.float32,
     ).astype(x.dtype)
     ctx.set_output(op, "Output", out)
 
@@ -142,13 +142,21 @@ def _pool(ctx, op, nd):
         strides = (1,) * nd
     window = (1, 1) + ksize
     wstrides = (1, 1) + strides
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    # ceil_mode: extend high-side padding so the last partial window counts
+    pads_hi = list(pads)
+    if op.attrs.get("ceil_mode", False):
+        for i in range(nd):
+            in_sz = x.shape[2 + i]
+            out_sz = -(-(in_sz - ksize[i] + 2 * pads[i]) // strides[i]) + 1  # ceil div
+            needed = (out_sz - 1) * strides[i] + ksize[i] - in_sz - pads[i]
+            pads_hi[i] = max(needed, pads[i])
+    padding = ((0, 0), (0, 0)) + tuple((p, ph) for p, ph in zip(pads, pads_hi))
     if ptype == "max":
         init = -jnp.inf if np.issubdtype(np.dtype(str(x.dtype).replace("bfloat16", "float32")), np.floating) else np.iinfo(np.int32).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides, padding)
     else:
         s = jax.lax.reduce_window(x.astype(jnp.float32), 0.0, jax.lax.add, window, wstrides, padding)
-        if op.attrs.get("exclusive", True) and any(pads):
+        if op.attrs.get("exclusive", True) and (any(pads) or any(pads_hi)):
             ones = jnp.ones(x.shape, jnp.float32)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides, padding)
             out = (s / cnt).astype(x.dtype)

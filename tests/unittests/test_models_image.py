@@ -1,0 +1,85 @@
+"""Image model smoke tests: build + a few training steps, loss finite & falling."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run_model(model, feed_shapes, steps=3, class_dim=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    img_shape, n_classes = feed_shapes
+    x = rng.randn(*img_shape).astype("float32")
+    y = rng.randint(0, n_classes, size=(img_shape[0], 1)).astype("int64")
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(model["startup"])
+        for _ in range(steps):
+            lv, = exe.run(
+                model["main"],
+                feed={model["feeds"][0]: x, model["feeds"][1]: y},
+                fetch_list=[model["loss"]],
+            )
+            losses.append(float(lv[0]))
+    assert all(np.isfinite(losses)), losses
+    return losses
+
+
+def test_mnist_lenet_converges():
+    from paddle_tpu.models import mnist
+
+    model = mnist.get_model(lr=0.001)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 1, 28, 28).astype("float32")
+    y = rng.randint(0, 10, size=(64, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(model["startup"])
+        losses = []
+        for _ in range(40):
+            lv, = exe.run(model["main"], feed={"pixel": x, "label": y}, fetch_list=[model["loss"]])
+            losses.append(float(lv[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # eval clone gives finite loss and doesn't touch params
+        lv, = exe.run(model["test"], feed={"pixel": x, "label": y}, fetch_list=[model["loss"]])
+        assert np.isfinite(lv[0])
+
+
+def test_resnet_cifar_smoke():
+    from paddle_tpu.models import resnet
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="data", shape=[3, 32, 32], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        predict = resnet.resnet_cifar10(img, 10, depth=8)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=predict, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    model = {"main": main, "startup": startup, "feeds": ["data", "label"], "loss": loss}
+    losses = _run_model(model, ((8, 3, 32, 32), 10), steps=4)
+    assert losses[-1] < losses[0] * 1.5  # moving, not exploding
+
+
+def test_resnet50_imagenet_builds_and_steps():
+    from paddle_tpu.models import resnet
+
+    model = resnet.get_model(batch_size=2, class_dim=100, depth=50, image_shape=(3, 64, 64))
+    losses = _run_model(model, ((2, 3, 64, 64), 100), steps=2)
+    assert np.isfinite(losses).all() if hasattr(np, "isfinite") else True
+
+
+def test_se_resnext_builds_and_steps():
+    from paddle_tpu.models import se_resnext
+
+    model = se_resnext.get_model(batch_size=2, class_dim=10, depth=50, image_shape=(3, 64, 64))
+    _run_model(model, ((2, 3, 64, 64), 10), steps=2)
+
+
+def test_vgg_builds_and_steps():
+    from paddle_tpu.models import vgg
+
+    model = vgg.get_model(batch_size=4, class_dim=10, image_shape=(3, 32, 32))
+    _run_model(model, ((4, 3, 32, 32), 10), steps=2)
