@@ -257,11 +257,71 @@ def _is_float(v):
         return False
 
 
+# Ops whose lowering rules manage the lengths companion themselves (set it,
+# or deliberately drop it — e.g. sequence_pool collapses the time axis).
+# Generic propagation must not second-guess them.
+_LENGTH_AWARE_OPS = frozenset(
+    {
+        "sequence_pool",
+        "sequence_softmax",
+        "sequence_conv",
+        "sequence_expand",
+        "sequence_expand_as",
+        "sequence_concat",
+        "sequence_reshape",
+        "sequence_enumerate",
+        "sequence_scatter",
+        "sequence_slice",
+        "sequence_pad",
+        "sequence_unpad",
+        "sequence_mask",
+        "sequence_erase",
+        "lod_reset",
+        "row_conv",
+        "lstm",
+        "lstmp",
+        "gru",
+        "im2sequence",
+    }
+)
+
+
+def _propagate_lengths(ctx: LoweringContext, op):
+    """Generic ragged-metadata flow: if an op didn't set lengths on an output
+    but some input carries them and the output preserves the [batch, time]
+    leading dims, the output inherits the input's lengths.  Keeps every
+    elementwise/matmul rule oblivious to the LoD companion convention."""
+    if op.type in _LENGTH_AWARE_OPS:
+        return
+    src = None
+    for names in op.inputs.values():
+        for n in names:
+            lens = ctx.env.get(n + "@LENGTHS")
+            if lens is not None:
+                v = ctx.env.get(n)
+                if v is not None and getattr(v, "ndim", 0) >= 2:
+                    src = (v.shape[:2], lens)
+                    break
+        if src:
+            break
+    if not src:
+        return
+    lead, lens = src
+    for names in op.outputs.values():
+        for n in names:
+            if n + "@LENGTHS" in ctx.env:
+                continue
+            v = ctx.env.get(n)
+            if v is not None and getattr(v, "ndim", 0) >= 2 and tuple(v.shape[:2]) == tuple(lead):
+                ctx.env[n + "@LENGTHS"] = lens
+
+
 def interpret_ops(ctx: LoweringContext, ops):
     """Straight-line trace of an op list (no backward meta-op)."""
     for op in ops:
         rule = get_rule(op.type)
         rule(ctx, op)
+        _propagate_lengths(ctx, op)
 
 
 def lower_block(ctx: LoweringContext, block: Block):

@@ -18,7 +18,11 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type
     layers/io.py:24) a leading -1 batch dim is added."""
     helper = LayerHelper("data")
     shape = list(shape)
-    if append_batch_size:
+    if lod_level >= 1:
+        # padded-ragged layout: [batch, max_len] + per-timestep shape (the
+        # reference's flat [sum_len]+lod becomes dense batch-major here)
+        shape = [-1, -1] + shape
+    elif append_batch_size:
         shape = [-1] + shape
     return helper.block.program.global_block().create_var(
         name=name,

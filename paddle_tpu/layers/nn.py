@@ -156,7 +156,13 @@ def embedding(input, size, is_sparse=False, is_distributed=False, padding_idx=No
     TPU it is a dense gather (one-hot matmul on MXU for small vocab)."""
     helper = LayerHelper("embedding", **locals())
     w = helper.create_parameter(attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False)
-    tmp = helper.create_variable_for_type_inference(dtype)
+    if input.shape is None:
+        out_shape = None
+    elif len(input.shape) and input.shape[-1] == 1:
+        out_shape = list(input.shape[:-1]) + [size[1]]  # trailing id dim folds away
+    else:
+        out_shape = list(input.shape) + [size[1]]
+    tmp = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     padding_idx = -1 if padding_idx is None else (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     helper.append_op(
         type="lookup_table",

@@ -8,3 +8,4 @@ from . import mnist  # noqa: F401
 from . import vgg  # noqa: F401
 from . import resnet  # noqa: F401
 from . import se_resnext  # noqa: F401
+from . import stacked_dynamic_lstm  # noqa: F401

@@ -52,7 +52,7 @@ def layer_warp(block_func, input, ch_out, count, stride, is_train=True):
 
 def resnet_imagenet(input, class_dim, depth=50, is_train=True):
     cfg = {
-        18: ([2, 2, 2, 1], basicblock),
+        18: ([2, 2, 2, 2], basicblock),
         34: ([3, 4, 6, 3], basicblock),
         50: ([3, 4, 6, 3], bottleneck),
         101: ([3, 4, 23, 3], bottleneck),

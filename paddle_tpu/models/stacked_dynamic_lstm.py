@@ -1,0 +1,54 @@
+"""Stacked dynamic LSTM for IMDB sentiment
+(reference: benchmark/fluid/models/stacked_dynamic_lstm.py).
+
+The reference builds a hand-rolled LSTM with DynamicRNN per-timestep fc ops;
+TPU-native we use ``dynamic_lstm`` (one fused lax.scan whose per-step gate
+matmul hits the MXU) — same network (embed 512 → tanh fc → LSTM stack →
+last-step pool → softmax fc), vastly better step time under XLA.
+"""
+from __future__ import annotations
+
+from .. import layers, optimizer as optim
+
+VOCAB_SIZE = 5147  # imdb.word_dict() size in the reference dataset
+LSTM_SIZE = 512
+EMB_DIM = 512
+
+
+def lstm_net(sentence, lstm_size, depth=1):
+    """reference stacked_dynamic_lstm.py:31 lstm_net (DynamicRNN loop) →
+    scan-based dynamic_lstm stack."""
+    hidden = layers.fc(input=sentence, size=lstm_size, act="tanh", num_flatten_dims=2)
+    for _ in range(depth):
+        proj = layers.fc(input=hidden, size=lstm_size * 4, num_flatten_dims=2)
+        hidden, _cell = layers.dynamic_lstm(input=proj, size=lstm_size * 4, use_peepholes=False)
+    last = layers.sequence_last_step(hidden)
+    logit = layers.fc(input=last, size=2, act="softmax")
+    return logit
+
+
+def get_model(batch_size=64, lstm_size=LSTM_SIZE, emb_dim=EMB_DIM, vocab_size=VOCAB_SIZE, depth=1, lr=0.001):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = layers.data(name="words", shape=[1], lod_level=1, dtype="int64")
+        sentence = layers.embedding(input=data, size=[vocab_size, emb_dim])
+        logit = lstm_net(sentence, lstm_size, depth=depth)
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        loss = layers.cross_entropy(input=logit, label=label)
+        avg_cost = layers.mean(x=loss)
+        batch_acc = layers.accuracy(input=logit, label=label)
+        inference_program = main.clone(for_test=True)
+        adam = optim.AdamOptimizer(learning_rate=lr)
+        adam.minimize(avg_cost)
+    return {
+        "main": main,
+        "startup": startup,
+        "test": inference_program,
+        "feeds": ["words", "label"],
+        "loss": avg_cost,
+        "acc": batch_acc,
+        "predict": logit,
+    }

@@ -1,0 +1,613 @@
+"""Sequence / recurrence op lowerings (padded+lengths ragged layout).
+
+Reference kernels: paddle/fluid/operators/sequence_ops/*, lstm_op.cc,
+gru_op.cc, row_conv_op.cc, lstm_unit_op.cc, gru_unit_op.cc.  The reference
+stores ragged batches flat ([sum_len, D] + LoD offsets) and dispatches
+per-sequence CPU/CUDA kernels; here every sequence tensor is dense padded
+``[batch, max_len, ...]`` with an int32 ``lengths`` companion
+(``name@LENGTHS`` in the trace env), and every kernel is a masked dense
+computation: static shapes, MXU-shaped matmuls, recurrences as ``lax.scan``
+over the time axis.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _mask(lengths, maxlen, dtype="float32"):
+    """[B] lengths -> [B, T] 0/1 mask."""
+    jnp = _jnp()
+    t = jnp.arange(maxlen, dtype=jnp.int32)[None, :]
+    return (t < lengths.astype(jnp.int32)[:, None]).astype(dtype)
+
+
+def _lengths_for(ctx, op, slot="X"):
+    jnp = _jnp()
+    name = op.inputs[slot][0]
+    x = ctx.get(name)
+    lens = ctx.get_lengths(name)
+    if lens is None:
+        # non-LoD input: every row is a full-length sequence
+        lens = _jnp().full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+    return lens
+
+
+def _reverse_seq(x, lengths):
+    """Reverse each sequence within its valid region (padding stays put)."""
+    jnp = _jnp()
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    L = lengths.astype(jnp.int32)[:, None]
+    idx = jnp.where(t < L, L - 1 - t, t)
+    return jnp.take_along_axis(x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+
+
+_ACTS = {}
+
+
+def _act(name):
+    import jax
+
+    jnp = _jnp()
+    if not _ACTS:
+        _ACTS.update(
+            sigmoid=jax.nn.sigmoid,
+            tanh=jnp.tanh,
+            relu=jax.nn.relu,
+            identity=lambda v: v,
+            linear=lambda v: v,
+        )
+    return _ACTS[name]
+
+
+# ---------------------------------------------------------------------------
+# pooling / softmax / conv over time
+# ---------------------------------------------------------------------------
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T, ...]
+    lens = _lengths_for(ctx, op)
+    pooltype = op.attrs.get("pooltype", "AVERAGE").upper()
+    B, T = x.shape[0], x.shape[1]
+    m = _mask(lens, T, x.dtype).reshape((B, T) + (1,) * (x.ndim - 2))
+    denom = jnp.maximum(lens.astype(x.dtype), 1).reshape((B,) + (1,) * (x.ndim - 2))
+    if pooltype == "AVERAGE":
+        out = (x * m).sum(axis=1) / denom
+    elif pooltype == "SUM":
+        out = (x * m).sum(axis=1)
+    elif pooltype == "SQRT":
+        out = (x * m).sum(axis=1) / jnp.sqrt(denom)
+    elif pooltype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jnp.where(m > 0, x, neg).max(axis=1)
+        idx = jnp.where(m > 0, x, neg).argmax(axis=1)
+        ctx.set_output(op, "MaxIndex", idx.astype(jnp.int32))
+    elif pooltype == "MIN":
+        pos = jnp.finfo(x.dtype).max if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max
+        out = jnp.where(m > 0, x, pos).min(axis=1)
+    elif pooltype == "LAST":
+        idx = jnp.maximum(lens.astype(jnp.int32) - 1, 0)
+        out = jnp.take_along_axis(x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    elif pooltype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError("sequence_pool type %r" % pooltype)
+    ctx.set_output(op, "Out", out)
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T] or [B, T, 1]
+    lens = _lengths_for(ctx, op)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    m = _mask(lens, v.shape[1], "bool")
+    v = jnp.where(m, v.astype("float32"), -1e30)
+    out = jax.nn.softmax(v, axis=1)
+    out = jnp.where(m, out, 0.0).astype(x.dtype)
+    if squeeze:
+        out = out[..., None]
+    ctx.set_output(op, "Out", out)
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, op):
+    """Context-window conv over time.  Filter [ctx_len * D, F]; window row k
+    sees x[t + context_start + k] (zero outside the sequence)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T, D]
+    w = ctx.get_input(op, "Filter")
+    lens = _lengths_for(ctx, op)
+    stride = int(op.attrs.get("contextStride", 1))
+    if stride != 1:
+        # same restriction as the reference (sequence_conv_op.cc PADDLE_ENFORCE)
+        raise NotImplementedError("sequence_conv: contextStride must be 1")
+    clen = int(op.attrs.get("contextLength", op.attrs.get("context_length", 3)))
+    cstart = op.attrs.get("contextStart", op.attrs.get("context_start"))
+    cstart = int(-(clen - 1) // 2 if cstart is None else cstart)
+    B, T, D = x.shape
+    m = _mask(lens, T, x.dtype)[:, :, None]
+    xm = x * m
+    cols = []
+    for k in range(clen):
+        off = cstart + k
+        if off < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-off, 0), (0, 0)))[:, :T]
+        elif off > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            shifted = xm
+        cols.append(shifted)
+    im = jnp.concatenate(cols, axis=-1)  # [B, T, clen*D]
+    out = (im.reshape(B * T, clen * D) @ w).reshape(B, T, -1) * m
+    ctx.set_output(op, "Out", out.astype(x.dtype))
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+@register("row_conv")
+def _row_conv(ctx, op):
+    """Lookahead conv (reference row_conv_op.cc): out[t] = sum_k x[t+k] * W[k]."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T, D]
+    w = ctx.get_input(op, "Filter")  # [future_context+1, D]
+    lens = _lengths_for(ctx, op)
+    B, T, D = x.shape
+    m = _mask(lens, T, x.dtype)[:, :, None]
+    xm = x * m
+    K = w.shape[0]
+    out = jnp.zeros_like(xm)
+    for k in range(K):
+        shifted = jnp.pad(xm, ((0, 0), (0, k), (0, 0)))[:, k : k + T] if k else xm
+        out = out + shifted * w[k][None, None, :]
+    ctx.set_output(op, "Out", (out * m).astype(x.dtype))
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+# ---------------------------------------------------------------------------
+# shape / structure ops
+# ---------------------------------------------------------------------------
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, op):
+    """Expand x by y's sequence structure (reference sequence_expand_op).
+    Padded-layout cases: x one step per batch row (the attention/seq2seq use)
+    -> broadcast over y's time axis; x already [B, T, ...] -> re-masked to
+    y's lengths."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    yname = op.inputs["Y"][0]
+    y = ctx.get(yname)
+    ylens = ctx.get_lengths(yname)
+    if ylens is None:
+        ylens = jnp.full((y.shape[0],), y.shape[1], dtype=jnp.int32)
+    T = y.shape[1]
+    if x.ndim == 2:  # [B, D] -> [B, T, D]
+        out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    elif x.shape[1] == 1:
+        out = jnp.broadcast_to(x, (x.shape[0], T) + x.shape[2:])
+    else:
+        out = x[:, :T] if x.shape[1] >= T else jnp.pad(x, ((0, 0), (0, T - x.shape[1])) + ((0, 0),) * (x.ndim - 2))
+    m = _mask(ylens, T, out.dtype).reshape((out.shape[0], T) + (1,) * (out.ndim - 2))
+    ctx.set_output(op, "Out", out * m)
+    ctx.set_lengths(op.outputs["Out"][0], ylens)
+
+
+@register("sequence_expand_as")
+def _sequence_expand_as(ctx, op):
+    _sequence_expand(ctx, op)
+
+
+@register("sequence_concat")
+def _sequence_concat(ctx, op):
+    """Concat along time per batch row, compacting valid prefixes:
+    out[b] = x1[b,:L1] ++ x2[b,:L2] ++ ... then zero padding."""
+    jnp = _jnp()
+    names = op.inputs["X"]
+    xs = [ctx.get(n) for n in names]
+    lens = []
+    for n, x in zip(names, xs):
+        ln = ctx.get_lengths(n)
+        lens.append(ln if ln is not None else jnp.full((x.shape[0],), x.shape[1], jnp.int32))
+    B = xs[0].shape[0]
+    Ttot = sum(int(x.shape[1]) for x in xs)
+    trail = xs[0].shape[2:]
+    out = jnp.zeros((B, Ttot) + trail, xs[0].dtype)
+    offs = jnp.zeros((B,), jnp.int32)
+    bidx = jnp.arange(B)[:, None]
+    for x, ln in zip(xs, lens):
+        T = x.shape[1]
+        pos = offs[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        valid = jnp.arange(T)[None, :] < ln[:, None]
+        pos = jnp.where(valid, pos, Ttot)  # out-of-range -> dropped
+        out = out.at[bidx, pos].set(x, mode="drop")
+        offs = offs + ln.astype(jnp.int32)
+    ctx.set_output(op, "Out", out)
+    ctx.set_lengths(op.outputs["Out"][0], offs)
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, op):
+    """[B,T,D] -> [B, T*D/new, new]; valid data stays a contiguous prefix of
+    each row, so a per-row reshape preserves the packing."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    lens = _lengths_for(ctx, op)
+    new_dim = int(op.attrs["new_dim"])
+    B, T = x.shape[0], x.shape[1]
+    D = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
+    if (T * D) % new_dim:
+        raise ValueError("sequence_reshape: T*D=%d not divisible by new_dim=%d" % (T * D, new_dim))
+    out = x.reshape(B, (T * D) // new_dim, new_dim)
+    ctx.set_output(op, "Out", out)
+    ctx.set_lengths(op.outputs["Out"][0], (lens * D) // new_dim)
+
+
+@register("sequence_enumerate")
+def _sequence_enumerate(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T] int ids (or [B,T,1])
+    lens = _lengths_for(ctx, op)
+    win = int(op.attrs["win_size"])
+    pad = op.attrs.get("pad_value", 0)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    B, T = v.shape
+    idx_np = np.minimum(np.arange(T)[:, None] + np.arange(win)[None, :], T - 1)  # [T, win] static
+    gathered = v[:, idx_np]  # [B, T, win]
+    L = lens.astype(jnp.int32)[:, None, None]
+    valid = (jnp.asarray(np.arange(T)[:, None] + np.arange(win)[None, :], jnp.int32)[None] < L)
+    out = jnp.where(valid, gathered, jnp.asarray(pad, v.dtype))
+    ctx.set_output(op, "Out", out)
+    ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+@register("sequence_scatter")
+def _sequence_scatter(ctx, op):
+    """out = x; out[b, ids[b, j]] += updates[b, j] for j < len(ids[b])."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, N] (or [B, N, D])
+    ids_name = op.inputs["Ids"][0]
+    ids = ctx.get(ids_name)
+    upd = ctx.get_input(op, "Updates")
+    ilens = ctx.get_lengths(ids_name)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    B, T = ids.shape
+    if ilens is None:
+        ilens = jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < ilens[:, None]
+    safe = jnp.where(valid, ids.astype(jnp.int32), x.shape[1])  # OOB -> dropped
+    bidx = jnp.arange(B)[:, None]
+    out = x.at[bidx, safe].add(jnp.where(valid.reshape(valid.shape + (1,) * (upd.ndim - 2)), upd, 0), mode="drop")
+    ctx.set_output(op, "Out", out)
+
+
+@register("sequence_slice")
+def _sequence_slice(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T, ...]
+    off = ctx.get_input(op, "Offset").reshape(-1).astype(_jnp().int32)
+    length = ctx.get_input(op, "Length").reshape(-1).astype(_jnp().int32)
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(off[:, None] + t, 0, T - 1)
+    out = jnp.take_along_axis(x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    m = _mask(length, T, x.dtype).reshape((B, T) + (1,) * (x.ndim - 2))
+    ctx.set_output(op, "Out", out * m)
+    ctx.set_lengths(op.outputs["Out"][0], length)
+
+
+@register("sequence_pad")
+def _sequence_pad(ctx, op):
+    jnp = _jnp()
+    xname = op.inputs["X"][0]
+    x = ctx.get(xname)
+    pad_value = ctx.get_input(op, "PadValue")
+    lens = _lengths_for(ctx, op)
+    maxlen = int(op.attrs.get("padded_length", -1))
+    T = x.shape[1]
+    if maxlen <= 0:
+        maxlen = T
+    if maxlen < T:
+        x = x[:, :maxlen]
+        lens = jnp.minimum(lens, maxlen)
+    elif maxlen > T:
+        x = jnp.pad(x, ((0, 0), (0, maxlen - T)) + ((0, 0),) * (x.ndim - 2))
+    m = _mask(lens, maxlen, x.dtype).reshape((x.shape[0], maxlen) + (1,) * (x.ndim - 2))
+    out = x * m + jnp.broadcast_to(pad_value.astype(x.dtype), x.shape) * (1 - m)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Length", lens.astype(jnp.int64))
+
+
+@register("sequence_unpad")
+def _sequence_unpad(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    length = ctx.get_input(op, "Length").reshape(-1).astype(_jnp().int32)
+    m = _mask(length, x.shape[1], x.dtype).reshape((x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2))
+    ctx.set_output(op, "Out", x * m)
+    ctx.set_lengths(op.outputs["Out"][0], length)
+
+
+@register("sequence_mask")
+def _sequence_mask_op(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").reshape(-1)
+    maxlen = int(op.attrs.get("maxlen", -1))
+    if maxlen < 0:
+        mv = ctx.get_input(op, "MaxLenTensor")
+        if mv is not None:
+            maxlen = int(mv)  # must be concrete (static shapes under jit)
+        else:
+            try:
+                maxlen = int(np.asarray(x).max())  # concrete lengths (startup path)
+            except Exception:
+                raise ValueError(
+                    "sequence_mask: maxlen=None needs the runtime max length, which "
+                    "is a dynamic shape under the static-shape TPU executor — pass "
+                    "an explicit maxlen (reference sequence_mask_op.h computes "
+                    "max(X) per batch at kernel time)"
+                ) from None
+    out = (jnp.arange(maxlen, dtype=jnp.int32)[None, :] < x.astype(jnp.int32)[:, None])
+    ctx.set_output(op, "Y", out.astype(op.attrs.get("out_dtype", "int64")))
+
+
+@register("sequence_erase")
+def _sequence_erase(ctx, op):
+    """Remove the listed tokens, compacting each sequence to the front."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # [B, T] ids (or [B,T,1])
+    tokens = op.attrs.get("tokens", [])
+    lens = _lengths_for(ctx, op)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    B, T = v.shape
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lens[:, None]
+    keep = valid
+    for tok in tokens:
+        keep = keep & (v != tok)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(keep, pos, T)  # dropped
+    out = jnp.zeros_like(v)
+    out = out.at[jnp.arange(B)[:, None], pos].set(v, mode="drop")
+    new_lens = keep.astype(jnp.int32).sum(axis=1)
+    if squeeze:
+        out = out[..., None]
+    ctx.set_output(op, "Out", out)
+    ctx.set_lengths(op.outputs["Out"][0], new_lens)
+
+
+@register("lod_reset")
+def _lod_reset(ctx, op):
+    jnp = _jnp()
+    xname = op.inputs["X"][0]
+    x = ctx.get(xname)
+    ctx.set_output(op, "Out", x)
+    if op.inputs.get("Y"):
+        yname = op.inputs["Y"][0]
+        ylens = ctx.get_lengths(yname)
+        if ylens is None:
+            # plain-Tensor Y carries LoD *offsets* (reference lod_reset_op.h):
+            # lengths are consecutive differences
+            offs = ctx.get(yname).reshape(-1).astype(jnp.int32)
+            ylens = offs[1:] - offs[:-1]
+        ctx.set_lengths(op.outputs["Out"][0], ylens)
+    else:
+        target = op.attrs.get("target_lod", [])
+        lens = np.diff(np.asarray(target, np.int32))
+        ctx.set_lengths(op.outputs["Out"][0], jnp.asarray(lens, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# recurrences (lax.scan over time)
+# ---------------------------------------------------------------------------
+
+
+def _scan_rnn(step, x, lens, init_carry, is_reverse=False):
+    """Run ``step(carry, xt) -> (carry, out)`` over the time axis of
+    ``x [B,T,...]`` with mask-gated carries.  Returns stacked outs [B,T,...]."""
+    import jax
+
+    jnp = _jnp()
+    B, T = x.shape[0], x.shape[1]
+    if is_reverse:
+        x = _reverse_seq(x, lens)
+    m = _mask(lens, T, x.dtype)  # [B, T]
+    xs = (jnp.moveaxis(x, 1, 0), jnp.moveaxis(m, 1, 0))  # time-major
+
+    def body(carry, inp):
+        xt, mt = inp
+        new_carry, out = step(carry, xt)
+        mt = mt[:, None]
+        gated = tuple(jnp.where(mt, n, c) for n, c in zip(new_carry, carry))
+        out = tuple(jnp.where(mt, o, 0) for o in out)
+        return gated, out
+
+    final, outs = jax.lax.scan(body, init_carry, xs)
+    outs = tuple(jnp.moveaxis(o, 0, 1) for o in outs)
+    if is_reverse:
+        outs = tuple(_reverse_seq(o, lens) for o in outs)
+    return final, outs
+
+
+@register("lstm")
+def _lstm(ctx, op):
+    """dynamic_lstm: input pre-projected [B,T,4D]; recurrent weight [D,4D]
+    with column blocks ordered {c, i, f, o} (reference lstm_op doc order
+    W_ch,W_ih,W_fh,W_oh); bias [1,4D] (+[3D] peephole W_ic,W_fc,W_oc)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Weight")
+    b = ctx.get_input(op, "Bias")
+    h0 = ctx.get_input(op, "H0")
+    c0 = ctx.get_input(op, "C0")
+    lens = _lengths_for(ctx, op, "Input")
+    D = w.shape[0]
+    B = x.shape[0]
+    use_peepholes = op.attrs.get("use_peepholes", True)
+    act_g = _act(op.attrs.get("gate_activation", "sigmoid"))
+    act_c = _act(op.attrs.get("cell_activation", "tanh"))
+    act_cand = _act(op.attrs.get("candidate_activation", "tanh"))
+    bias = b.reshape(-1)
+    b_gate = bias[: 4 * D]
+    w_ic = bias[4 * D : 5 * D] if use_peepholes else 0.0
+    w_fc = bias[5 * D : 6 * D] if use_peepholes else 0.0
+    w_oc = bias[6 * D : 7 * D] if use_peepholes else 0.0
+    h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
+
+    def step(carry, xt):
+        h, c = carry
+        g = xt + h @ w + b_gate
+        g_c, g_i, g_f, g_o = jnp.split(g, 4, axis=-1)
+        i = act_g(g_i + w_ic * c if use_peepholes else g_i)
+        f = act_g(g_f + w_fc * c if use_peepholes else g_f)
+        c_new = f * c + i * act_cand(g_c)
+        o = act_g(g_o + w_oc * c_new if use_peepholes else g_o)
+        h_new = o * act_c(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    _, (hs, cs) = _scan_rnn(step, x, lens, (h_init, c_init), op.attrs.get("is_reverse", False))
+    ctx.set_output(op, "Hidden", hs)
+    ctx.set_output(op, "Cell", cs)
+    ctx.set_lengths(op.outputs["Hidden"][0], lens)
+    if op.outputs.get("Cell"):
+        ctx.set_lengths(op.outputs["Cell"][0], lens)
+
+
+@register("lstmp")
+def _lstmp(ctx, op):
+    """dynamic_lstmp (reference lstmp_op): LSTM with a recurrent projection;
+    recurrent weight [P,4D], projection weight [D,P]."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")  # [B,T,4D]
+    w = ctx.get_input(op, "Weight")  # [P,4D]
+    w_proj = ctx.get_input(op, "ProjWeight")  # [D,P]
+    b = ctx.get_input(op, "Bias")
+    lens = _lengths_for(ctx, op, "Input")
+    P, D4 = w.shape
+    D = D4 // 4
+    B = x.shape[0]
+    use_peepholes = op.attrs.get("use_peepholes", True)
+    act_g = _act(op.attrs.get("gate_activation", "sigmoid"))
+    act_c = _act(op.attrs.get("cell_activation", "tanh"))
+    act_cand = _act(op.attrs.get("candidate_activation", "tanh"))
+    act_p = _act(op.attrs.get("proj_activation", "tanh"))
+    bias = b.reshape(-1)
+    b_gate = bias[: 4 * D]
+    w_ic = bias[4 * D : 5 * D] if use_peepholes else 0.0
+    w_fc = bias[5 * D : 6 * D] if use_peepholes else 0.0
+    w_oc = bias[6 * D : 7 * D] if use_peepholes else 0.0
+
+    def step(carry, xt):
+        r, c = carry  # r: [B,P] projected hidden
+        g = xt + r @ w + b_gate
+        g_c, g_i, g_f, g_o = jnp.split(g, 4, axis=-1)
+        i = act_g(g_i + w_ic * c if use_peepholes else g_i)
+        f = act_g(g_f + w_fc * c if use_peepholes else g_f)
+        c_new = f * c + i * act_cand(g_c)
+        o = act_g(g_o + w_oc * c_new if use_peepholes else g_o)
+        h_new = o * act_c(c_new)
+        r_new = act_p(h_new @ w_proj)
+        return (r_new, c_new), (r_new, c_new)
+
+    init = (jnp.zeros((B, P), x.dtype), jnp.zeros((B, D), x.dtype))
+    _, (rs, cs) = _scan_rnn(step, x, lens, init, op.attrs.get("is_reverse", False))
+    ctx.set_output(op, "Projection", rs)
+    ctx.set_output(op, "Cell", cs)
+    ctx.set_lengths(op.outputs["Projection"][0], lens)
+
+
+def _gru_step(xt, h, w, bias, act_g, act_c, origin_mode=False):
+    jnp = _jnp()
+    D = h.shape[-1]
+    w_ur, w_c = w[:, : 2 * D], w[:, 2 * D :]
+    g_ur = xt[:, : 2 * D] + h @ w_ur + (bias[: 2 * D] if bias is not None else 0.0)
+    u, r = jnp.split(act_g(g_ur), 2, axis=-1)
+    g_c = xt[:, 2 * D :] + (r * h) @ w_c + (bias[2 * D :] if bias is not None else 0.0)
+    c = act_c(g_c)
+    # reference gru_op: origin_mode h = u*h_prev+(1-u)*c ; default doc formula
+    h_new = u * h + (1 - u) * c if origin_mode else (1 - u) * h + u * c
+    return h_new, u, r, c
+
+
+@register("gru")
+def _gru(ctx, op):
+    """dynamic_gru: input pre-projected [B,T,3D]; weight [D,3D]
+    ({update,reset} gates then candidate); bias [1,3D]."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Weight")
+    b = ctx.get_input(op, "Bias")
+    h0 = ctx.get_input(op, "H0")
+    lens = _lengths_for(ctx, op, "Input")
+    D = w.shape[0]
+    B = x.shape[0]
+    act_g = _act(op.attrs.get("gate_activation", "sigmoid"))
+    act_c = _act(op.attrs.get("candidate_activation", "tanh"))
+    origin_mode = op.attrs.get("origin_mode", False)
+    bias = b.reshape(-1) if b is not None else None
+    h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+
+    def step(carry, xt):
+        (h,) = carry
+        h_new, _, _, _ = _gru_step(xt, h, w, bias, act_g, act_c, origin_mode)
+        return (h_new,), (h_new,)
+
+    _, (hs,) = _scan_rnn(step, x, lens, (h_init,), op.attrs.get("is_reverse", False))
+    ctx.set_output(op, "Hidden", hs)
+    ctx.set_lengths(op.outputs["Hidden"][0], lens)
+
+
+@register("gru_unit")
+def _gru_unit(ctx, op):
+    jnp = _jnp()
+    xt = ctx.get_input(op, "Input")  # [B, 3D]
+    h = ctx.get_input(op, "HiddenPrev")
+    w = ctx.get_input(op, "Weight")
+    b = ctx.get_input(op, "Bias")
+    act_map = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+    act_g = _act(act_map.get(op.attrs.get("gate_activation", 1), "sigmoid"))
+    act_c = _act(act_map.get(op.attrs.get("activation", 2), "tanh"))
+    bias = b.reshape(-1) if b is not None else None
+    h_new, u, r, c = _gru_step(xt, h, w, bias, act_g, act_c, op.attrs.get("origin_mode", False))
+    ctx.set_output(op, "Hidden", h_new)
+    ctx.set_output(op, "Gate", jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_output(op, "ResetHiddenPrev", r * h)
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, op):
+    """Single-step LSTM elementwise part (reference lstm_unit_op.h): input
+    X=[B,4D] gates ordered {i, f, o, g}; C = f*c_prev + i*tanh(g),
+    H = o*tanh(C)."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    c_prev = ctx.get_input(op, "C_prev")
+    forget_bias = op.attrs.get("forget_bias", 0.0)
+    g_i, g_f, g_o, g_g = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(g_i)
+    f = jax.nn.sigmoid(g_f + forget_bias)
+    o = jax.nn.sigmoid(g_o)
+    c = f * c_prev + i * jnp.tanh(g_g)
+    h = o * jnp.tanh(c)
+    ctx.set_output(op, "C", c)
+    ctx.set_output(op, "H", h)

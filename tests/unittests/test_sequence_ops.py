@@ -1,0 +1,385 @@
+"""Sequence op tests vs NumPy references on the padded+lengths layout
+(mirrors reference tests/unittests/test_sequence_*_op.py, test_lstm_op.py,
+test_gru_op.py strategy: compare against a plain-Python reference impl)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDArray, pack_sequences
+
+
+def _run(build, feeds, fetch):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed=feeds, fetch_list=outs if isinstance(outs, (list, tuple)) else [outs])
+    return res
+
+
+def _lod(rng, lens, feat=None, dtype="float32", hi=None):
+    seqs = []
+    for L in lens:
+        shape = (L,) if feat is None else (L, feat)
+        if hi is not None:
+            seqs.append(rng.randint(0, hi, size=shape).astype(dtype))
+        else:
+            seqs.append(rng.randn(*shape).astype(dtype))
+    return pack_sequences(seqs)
+
+
+def test_sequence_pool_types():
+    rng = np.random.RandomState(0)
+    lens = [3, 5, 1, 4]
+    x = _lod(rng, lens, feat=6)
+    data, L = x.data, x.lengths
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[6], lod_level=1, dtype="float32")
+        return [
+            fluid.layers.sequence_pool(xv, "average"),
+            fluid.layers.sequence_pool(xv, "sum"),
+            fluid.layers.sequence_pool(xv, "sqrt"),
+            fluid.layers.sequence_pool(xv, "max"),
+            fluid.layers.sequence_first_step(xv),
+            fluid.layers.sequence_last_step(xv),
+        ]
+
+    avg, s, sq, mx, first, last = _run(build, {"x": x}, None)
+    for b, l in enumerate(lens):
+        valid = data[b, :l]
+        np.testing.assert_allclose(avg[b], valid.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(s[b], valid.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(sq[b], valid.sum(0) / np.sqrt(l), rtol=1e-5)
+        np.testing.assert_allclose(mx[b], valid.max(0), rtol=1e-5)
+        np.testing.assert_allclose(first[b], valid[0], rtol=1e-5)
+        np.testing.assert_allclose(last[b], valid[-1], rtol=1e-5)
+
+
+def test_sequence_softmax_masks_padding():
+    rng = np.random.RandomState(1)
+    lens = [2, 4, 3]
+    x = _lod(rng, lens)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[], lod_level=1, dtype="float32")
+        return fluid.layers.sequence_softmax(xv)
+
+    (out,) = _run(build, {"x": x}, None)
+    for b, l in enumerate(lens):
+        e = np.exp(x.data[b, :l] - x.data[b, :l].max())
+        np.testing.assert_allclose(out[b, :l], e / e.sum(), rtol=1e-5)
+        assert np.all(out[b, l:] == 0)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+
+def test_sequence_concat_compacts():
+    rng = np.random.RandomState(2)
+    a = _lod(rng, [2, 1], feat=3)
+    b = _lod(rng, [1, 3], feat=3)
+
+    def build():
+        av = fluid.layers.data(name="a", shape=[3], lod_level=1, dtype="float32")
+        bv = fluid.layers.data(name="b", shape=[3], lod_level=1, dtype="float32")
+        return fluid.layers.sequence_concat([av, bv])
+
+    (out,) = _run(build, {"a": a, "b": b}, None)
+    # row 0: a0 (2 steps) then b0 (1 step)
+    np.testing.assert_allclose(out[0, :2], a.data[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2:3], b.data[0, :1], rtol=1e-6)
+    assert np.all(out[0, 3:] == 0)
+    # row 1: a1 (1 step) then b1 (3 steps)
+    np.testing.assert_allclose(out[1, :1], a.data[1, :1], rtol=1e-6)
+    np.testing.assert_allclose(out[1, 1:4], b.data[1, :3], rtol=1e-6)
+
+
+def test_sequence_reshape_and_lengths():
+    rng = np.random.RandomState(3)
+    x = _lod(rng, [2, 3], feat=4)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[4], lod_level=1, dtype="float32")
+        r = fluid.layers.sequence_reshape(xv, new_dim=2)
+        return fluid.layers.sequence_pool(r, "sum")
+
+    (pooled,) = _run(build, {"x": x}, None)
+    for b, l in enumerate([2, 3]):
+        ref = x.data[b, :l].reshape(-1, 2).sum(0)
+        np.testing.assert_allclose(pooled[b], ref, rtol=1e-5)
+
+
+def test_sequence_expand_broadcast():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 5).astype("float32")
+    y = _lod(rng, [2, 4, 1], feat=2)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[5], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[2], lod_level=1, dtype="float32")
+        ex = fluid.layers.sequence_expand(xv, yv)
+        return fluid.layers.sequence_pool(ex, "sum")
+
+    (pooled,) = _run(build, {"x": x, "y": y}, None)
+    for b, l in enumerate([2, 4, 1]):
+        np.testing.assert_allclose(pooled[b], x[b] * l, rtol=1e-5)
+
+
+def test_sequence_slice_and_mask_and_enumerate():
+    rng = np.random.RandomState(5)
+    x = _lod(rng, [4, 6], feat=2)
+    off = np.array([[1], [2]], dtype="int64")
+    ln = np.array([[2], [3]], dtype="int64")
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[2], lod_level=1, dtype="float32")
+        ov = fluid.layers.data(name="off", shape=[1], dtype="int64")
+        lv = fluid.layers.data(name="len", shape=[1], dtype="int64")
+        sl = fluid.layers.sequence_slice(xv, ov, lv)
+        pooled = fluid.layers.sequence_pool(sl, "sum")
+        lens_in = fluid.layers.data(name="lens", shape=[], append_batch_size=True, dtype="int64")
+        mask = fluid.layers.sequence_mask(lens_in, maxlen=5, dtype="float32")
+        ids = fluid.layers.data(name="ids", shape=[], lod_level=1, dtype="int64")
+        enum = fluid.layers.sequence_enumerate(ids, win_size=2, pad_value=0)
+        return [pooled, mask, enum]
+
+    ids = _lod(rng, [3, 5], dtype="int64", hi=9)
+    pooled, mask, enum = _run(
+        build,
+        {"x": x, "off": off, "len": ln, "lens": np.array([2, 4], "int64"), "ids": ids},
+        None,
+    )
+    np.testing.assert_allclose(pooled[0], x.data[0, 1:3].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(pooled[1], x.data[1, 2:5].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(mask, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    # enumerate row 0 len 3: windows [i0,i1],[i1,i2],[i2,pad]
+    v = ids.data
+    assert enum[0, 0, 0] == v[0, 0] and enum[0, 0, 1] == v[0, 1]
+    assert enum[0, 2, 0] == v[0, 2] and enum[0, 2, 1] == 0
+    assert np.all(enum[0, 3:] == 0)
+
+
+def test_sequence_erase_compacts():
+    seqs = [np.array([3, 5, 3, 7], "int64"), np.array([5, 5, 1], "int64")]
+    x = pack_sequences(seqs)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[], lod_level=1, dtype="int64")
+        er = fluid.layers.sequence_erase(xv, tokens=[5])
+        return fluid.layers.sequence_pool(er, "sum")
+
+    (pooled,) = _run(build, {"x": x}, None)
+    assert pooled[0] == 3 + 3 + 7
+    assert pooled[1] == 1
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(6)
+    lens = [3, 5]
+    x = _lod(rng, lens, feat=4)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[4], lod_level=1, dtype="float32")
+        return fluid.layers.sequence_conv(xv, num_filters=3, filter_size=3, bias_attr=False)
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = np.array(scope.find_var([p.name for p in main.global_block().all_parameters()][0]).get_tensor())
+        (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    for b, l in enumerate(lens):
+        valid = x.data[b, :l]
+        padded = np.vstack([np.zeros((1, 4), "float32"), valid, np.zeros((1, 4), "float32")])
+        for t in range(l):
+            window = padded[t : t + 3].reshape(-1)
+            np.testing.assert_allclose(res[b, t], window @ w, rtol=1e-4, atol=1e-5)
+        assert np.all(res[b, l:] == 0)
+
+
+def _np_lstm(x, w, b, lens, peephole=False):
+    """NumPy reference LSTM, gate order {c,i,f,o}, sigmoid/tanh."""
+    B, T, D4 = x.shape
+    D = D4 // 4
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, T, D), "float32")
+    cs = np.zeros((B, T, D), "float32")
+    for bidx in range(B):
+        h = np.zeros(D, "float32")
+        c = np.zeros(D, "float32")
+        for t in range(int(lens[bidx])):
+            g = x[bidx, t] + h @ w + b[0, : 4 * D]
+            gc, gi, gf, go = np.split(g, 4)
+            if peephole:
+                gi = gi + b[0, 4 * D : 5 * D] * c
+                gf = gf + b[0, 5 * D : 6 * D] * c
+            i, f = sig(gi), sig(gf)
+            c = f * c + i * np.tanh(gc)
+            if peephole:
+                go = go + b[0, 6 * D : 7 * D] * c
+            o = sig(go)
+            h = o * np.tanh(c)
+            hs[bidx, t] = h
+            cs[bidx, t] = c
+    return hs, cs
+
+
+@pytest.mark.parametrize("peephole", [False, True])
+def test_dynamic_lstm_matches_numpy(peephole):
+    rng = np.random.RandomState(7)
+    lens = [3, 5, 2]
+    D = 4
+    x = _lod(rng, lens, feat=4 * D)
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[4 * D], lod_level=1, dtype="float32")
+        h, c = fluid.layers.dynamic_lstm(input=xv, size=4 * D, use_peepholes=peephole)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        params = {p.name: np.array(scope.find_var(p.name).get_tensor()) for p in main.global_block().all_parameters()}
+        hv, cv = exe.run(main, feed={"x": x}, fetch_list=[h, c])
+    wname = [n for n in params if params[n].shape == (D, 4 * D)][0]
+    bname = [n for n in params if params[n].ndim == 2 and params[n].shape[0] == 1][0]
+    href, cref = _np_lstm(x.data, params[wname], params[bname], x.lengths, peephole)
+    np.testing.assert_allclose(hv, href, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cv, cref, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstm_reverse_runs():
+    rng = np.random.RandomState(8)
+    x = _lod(rng, [2, 4], feat=8)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[8], lod_level=1, dtype="float32")
+        h, _ = fluid.layers.dynamic_lstm(input=xv, size=8, use_peepholes=False, is_reverse=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (hv,) = exe.run(main, feed={"x": x}, fetch_list=[h])
+    # padding of the shorter sequence stays zero
+    assert np.all(hv[0, 2:] == 0)
+    assert not np.all(hv[0, :2] == 0)
+
+
+def _np_gru(x, w, b, lens):
+    B, T, D3 = x.shape
+    D = D3 // 3
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, T, D), "float32")
+    for bi in range(B):
+        h = np.zeros(D, "float32")
+        for t in range(int(lens[bi])):
+            g = x[bi, t, : 2 * D] + h @ w[:, : 2 * D] + b[0, : 2 * D]
+            u, r = np.split(sig(g), 2)
+            cand = np.tanh(x[bi, t, 2 * D :] + (r * h) @ w[:, 2 * D :] + b[0, 2 * D :])
+            h = (1 - u) * h + u * cand
+            hs[bi, t] = h
+    return hs
+
+
+def test_dynamic_gru_matches_numpy():
+    rng = np.random.RandomState(9)
+    lens = [4, 2]
+    D = 5
+    x = _lod(rng, lens, feat=3 * D)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3 * D], lod_level=1, dtype="float32")
+        h = fluid.layers.dynamic_gru(input=xv, size=D)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        scope = fluid.global_scope()
+        params = {p.name: np.array(scope.find_var(p.name).get_tensor()) for p in main.global_block().all_parameters()}
+        (hv,) = exe.run(main, feed={"x": x}, fetch_list=[h])
+    wname = [n for n in params if params[n].shape == (D, 3 * D)][0]
+    bname = [n for n in params if params[n].shape == (1, 3 * D)][0]
+    href = _np_gru(x.data, params[wname], params[bname], x.lengths)
+    np.testing.assert_allclose(hv, href, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unit_and_lstm_unit_run():
+    rng = np.random.RandomState(10)
+    B, D = 3, 4
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3 * D], dtype="float32")
+        hv = fluid.layers.data(name="h", shape=[D], dtype="float32")
+        new_h, _, _ = fluid.layers.gru_unit(input=xv, hidden=hv, size=3 * D)
+        x2 = fluid.layers.data(name="x2", shape=[D], dtype="float32")
+        c0 = fluid.layers.data(name="c0", shape=[D], dtype="float32")
+        h2, c2 = fluid.layers.lstm_unit(x_t=x2, hidden_t_prev=hv, cell_t_prev=c0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        outs = exe.run(
+            main,
+            feed={
+                "x": rng.randn(B, 3 * D).astype("float32"),
+                "h": rng.randn(B, D).astype("float32"),
+                "x2": rng.randn(B, D).astype("float32"),
+                "c0": rng.randn(B, D).astype("float32"),
+            },
+            fetch_list=[new_h, h2, c2],
+        )
+    assert outs[0].shape == (B, D)
+    assert outs[1].shape == (B, D) and outs[2].shape == (B, D)
+
+
+def test_row_conv_lookahead():
+    rng = np.random.RandomState(11)
+    x = _lod(rng, [3, 5], feat=2)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2], lod_level=1, dtype="float32")
+        out = fluid.layers.row_conv(xv, future_context_size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        scope = fluid.global_scope()
+        w = np.array(scope.find_var(main.global_block().all_parameters()[0].name).get_tensor())
+        (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    for b, l in enumerate([3, 5]):
+        valid = np.vstack([x.data[b, :l], np.zeros((2, 2), "float32")])
+        for t in range(l):
+            ref = sum(valid[t + k] * w[k] for k in range(3))
+            np.testing.assert_allclose(res[b, t], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_grad_flows():
+    """Training through dynamic_lstm decreases a toy loss."""
+    rng = np.random.RandomState(12)
+    lens = [5, 3, 4, 5]
+    x = _lod(rng, lens, feat=8)
+    y = np.array([[0], [1], [1], [0]], "int64")
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[8], lod_level=1, dtype="float32")
+        lab = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        proj = fluid.layers.fc(input=xv, size=24, num_flatten_dims=2)
+        h, _ = fluid.layers.dynamic_lstm(input=proj, size=24, use_peepholes=False)
+        last = fluid.layers.sequence_last_step(h)
+        pred = fluid.layers.fc(input=last, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=lab))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

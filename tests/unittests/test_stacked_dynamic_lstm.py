@@ -1,0 +1,30 @@
+"""Model smoke: stacked dynamic LSTM sentiment net trains
+(reference: benchmark/fluid/models/stacked_dynamic_lstm.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from paddle_tpu.models import stacked_dynamic_lstm as model
+
+
+def test_stacked_dynamic_lstm_trains():
+    m = model.get_model(lstm_size=32, emb_dim=16, vocab_size=100, depth=2, lr=0.01)
+    rng = np.random.RandomState(0)
+    B, T = 8, 12
+    lens = rng.randint(4, T + 1, size=B)
+    # two classes keyed on whether early tokens are low or high ids
+    labels = rng.randint(0, 2, size=(B, 1)).astype("int64")
+    seqs = []
+    for b in range(B):
+        lo, hi = (0, 50) if labels[b, 0] == 0 else (50, 100)
+        seqs.append(rng.randint(lo, hi, size=(lens[b], 1)).astype("int64"))
+    words = pack_sequences(seqs, maxlen=T)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(m["startup"])
+        losses = []
+        for _ in range(25):
+            (lv,) = exe.run(m["main"], feed={"words": words, "label": labels}, fetch_list=[m["loss"]])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
