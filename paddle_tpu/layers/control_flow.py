@@ -73,7 +73,7 @@ def increment(x, value=1.0, in_place=True):
 def less_than(x, y, force_cpu=None, cond=None, **ignored):
     helper = LayerHelper("less_than")
     if cond is None:
-        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond = helper.create_variable_for_type_inference(dtype="bool", shape=x.shape)
         cond.stop_gradient = True
     helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
     return cond
@@ -82,7 +82,7 @@ def less_than(x, y, force_cpu=None, cond=None, **ignored):
 def equal(x, y, cond=None, **ignored):
     helper = LayerHelper("equal")
     if cond is None:
-        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond = helper.create_variable_for_type_inference(dtype="bool", shape=x.shape)
         cond.stop_gradient = True
     helper.append_op(type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
     return cond
@@ -260,7 +260,10 @@ def _while_lower(ctx, op):
             # first body trace. We allocate there; here seed length only.
             ctx.set(an + "@ARRAYLEN", jnp.zeros((), dtype="int32"))
 
-    carry_keys = [cond_name] + [n for n in carried_names if n != cond_name]
+    # array vars are carried as @ARRAY/@ARRAYLEN pairs, not as plain values
+    carry_keys = [cond_name] + [
+        n for n in carried_names if n != cond_name and n not in array_names
+    ]
 
     def snapshot():
         d = {}

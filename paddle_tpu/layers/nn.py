@@ -16,6 +16,8 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "fc",
+    "beam_search",
+    "beam_search_decode",
     "embedding",
     "dropout",
     "cross_entropy",
@@ -531,7 +533,17 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None, pad
 
 def _reduce(op_type, input, dim, keep_dim, name):
     helper = LayerHelper(op_type, name=name)
-    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    shape = None
+    if input.shape is not None:
+        if dim is None:
+            shape = [1] * len(input.shape) if keep_dim else [1]
+        else:
+            dims = [d % len(input.shape) for d in (dim if isinstance(dim, (list, tuple)) else [dim])]
+            if keep_dim:
+                shape = [1 if i in dims else s for i, s in enumerate(input.shape)]
+            else:
+                shape = [s for i, s in enumerate(input.shape) if i not in dims] or [1]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shape)
     helper.append_op(
         type=op_type,
         inputs={"X": [input]},
@@ -573,7 +585,22 @@ def split(input, num_or_sections, dim=-1, name=None):
     else:
         num = len(num_or_sections)
         sections = list(num_or_sections)
-    outs = [helper.create_variable_for_type_inference(dtype=input.dtype) for _ in range(num)]
+    shapes = [None] * num
+    if input.shape is not None:
+        ax = dim % len(input.shape)
+        if sections:
+            sizes = sections
+        elif input.shape[ax] is not None and input.shape[ax] > 0:
+            sizes = [input.shape[ax] // num] * num
+        else:
+            sizes = [None] * num
+        shapes = [
+            [sz if i == ax else s for i, s in enumerate(input.shape)] for sz in sizes
+        ]
+    outs = [
+        helper.create_variable_for_type_inference(dtype=input.dtype, shape=shapes[k])
+        for k in range(num)
+    ]
     helper.append_op(
         type="split",
         inputs={"X": [input]},
@@ -600,7 +627,13 @@ def l2_normalize(x, axis, epsilon=1e-12, name=None):
 
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     helper = LayerHelper("matmul", name=name)
-    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    shape = None
+    if x.shape is not None and y.shape is not None and len(x.shape) >= 2 and len(y.shape) >= 2:
+        m = x.shape[-1] if transpose_x else x.shape[-2]
+        n = y.shape[-2] if transpose_y else y.shape[-1]
+        batch = list(x.shape[:-2]) if len(x.shape) >= len(y.shape) else list(y.shape[:-2])
+        shape = batch + [m, n]
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=shape)
     helper.append_op(
         type="matmul",
         inputs={"X": [x], "Y": [y]},
@@ -687,23 +720,55 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     return counter
 
 
+def _infer_reshape_shape(in_shape, shape):
+    """Static output-shape inference with reference reshape semantics
+    (0 = copy input dim, one -1 = inferred); None where unknowable."""
+    if in_shape is None:
+        # explicit dims are still known; 0 (copy) is not, -1 stays symbolic
+        return [int(s) if s not in (0,) else None for s in shape]
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(in_shape[i] if i < len(in_shape) else None)
+        else:
+            out.append(int(s))
+    if None in out:
+        return out
+    known = [d for d in out if d != -1]
+    if -1 in out and all(d is not None and d >= 0 for d in in_shape):
+        total = int(np.prod(in_shape))
+        rest = int(np.prod(known)) if known else 1
+        out[out.index(-1)] = total // rest if rest else -1
+    return out
+
+
 def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
     helper = LayerHelper("reshape", name=name)
-    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype=x.dtype, shape=_infer_reshape_shape(x.shape, shape))
     helper.append_op(type="reshape", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"shape": list(shape)})
     return helper.append_activation(out) if act else out
 
 
 def squeeze(input, axes, name=None):
     helper = LayerHelper("squeeze", name=name)
-    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    shape = None
+    if input.shape is not None:
+        dims = [a % len(input.shape) for a in axes]
+        shape = [s for i, s in enumerate(input.shape) if i not in dims]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shape)
     helper.append_op(type="squeeze", inputs={"X": [input]}, outputs={"Out": [out]}, attrs={"axes": list(axes)})
     return out
 
 
 def unsqueeze(input, axes, name=None):
     helper = LayerHelper("unsqueeze", name=name)
-    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    shape = None
+    if input.shape is not None:
+        shape = list(input.shape)
+        for a in sorted(axes):
+            shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shape)
     helper.append_op(type="unsqueeze", inputs={"X": [input]}, outputs={"Out": [out]}, attrs={"axes": list(axes)})
     return out
 
@@ -1125,7 +1190,7 @@ def elementwise_pow(x, y, axis=-1, act=None, name=None):
 def _logical(op_type, x, y, out=None, name=None):
     helper = LayerHelper(op_type, name=name)
     if out is None:
-        out = helper.create_variable_for_type_inference(dtype="bool")
+        out = helper.create_variable_for_type_inference(dtype="bool", shape=x.shape)
         out.stop_gradient = True
     inputs = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
     helper.append_op(type=op_type, inputs=inputs, outputs={"Out": [out]})
@@ -1263,3 +1328,48 @@ def huber_loss(input, label, delta):
         attrs={"delta": delta},
     )
     return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id, level=0, name=None):
+    """One beam-expansion step (reference nn.py:3280 / beam_search_op.cc).
+
+    TPU-native static-beam contract (see ops/decode_ops.py): all tensors are
+    ``[batch, beam]``-shaped; ``ids``/``scores`` are the per-beam candidate
+    ids and ACCUMULATED log-probs ``[batch, beam, K]``.  Returns
+    ``(selected_ids, selected_scores, parent_idx)`` — parenthood is explicit
+    instead of LoD-encoded, so the whole step is one fused topk on device.
+    Seed ``pre_scores`` with ``[0, -1e9, ...]`` per batch row on step 0 (the
+    reference gets this effect from lod of the init ids).
+    """
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference(dtype=ids.dtype, shape=pre_ids.shape)
+    sel_scores = helper.create_variable_for_type_inference(dtype=scores.dtype, shape=pre_scores.shape)
+    parent_idx = helper.create_variable_for_type_inference(dtype="int32", shape=pre_ids.shape, stop_gradient=True)
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores], "ids": [ids], "scores": [scores]},
+        outputs={"selected_ids": [sel_ids], "selected_scores": [sel_scores], "parent_idx": [parent_idx]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level},
+    )
+    return sel_ids, sel_scores, parent_idx
+
+
+def beam_search_decode(ids, scores, parents, beam_size, end_id, name=None):
+    """Backtrace beams into full sentences (reference nn.py:3349 /
+    beam_search_decode_op.cc).  ``ids``/``scores``/``parents`` are tensor
+    arrays written once per decode step via ``array_write`` (each element
+    ``[batch, beam]``).  Returns ``sentence_ids [batch, beam, T]`` (padded
+    with ``end_id`` past each sentence's finish) and ``sentence_scores
+    [batch, beam]``; the reference's LoD-packed result is replaced by this
+    dense layout (backtrace = one reversed lax.scan on device).
+    """
+    helper = LayerHelper("beam_search_decode", name=name)
+    sentence_ids = helper.create_variable_for_type_inference(dtype="int64", stop_gradient=True)
+    sentence_scores = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores], "Parents": [parents]},
+        outputs={"SentenceIds": [sentence_ids], "SentenceScores": [sentence_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sentence_ids, sentence_scores

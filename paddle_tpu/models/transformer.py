@@ -1,0 +1,315 @@
+"""Transformer (base) for WMT en-de machine translation.
+
+Reference: python/paddle/fluid/tests/unittests/transformer_model.py and the
+fluid Transformer benchmark (test_parallel_executor_transformer.py,
+dist_transformer.py).  Same network — post-norm Transformer-base:
+n_layer=6, d_model=512, n_head=8, d_inner=2048, sinusoid position encoding,
+label smoothing 0.1, Adam + noam LR decay — rebuilt TPU-first:
+
+- Static padded [batch, seq_len] token layout; attention masks are computed
+  in-graph from the pad id (no LoD, no host-side bias tensors to feed).
+- Every projection is an MXU matmul (fc with num_flatten_dims=2); the whole
+  step traces to ONE XLA computation, so residual/bias/softmax/dropout all
+  fuse — there is no per-op kernel dispatch to amortize.
+- bf16-friendly: softmax/log_softmax run in f32 inside the op lowerings.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers, nets  # noqa: F401
+from .. import optimizer as optim
+from ..initializer import NumpyArrayInitializer
+from ..param_attr import ParamAttr
+
+# Transformer-base hyperparameters (reference transformer_model.py / the
+# ModelHyperParams in dist_transformer.py)
+D_MODEL = 512
+D_INNER = 2048
+N_HEAD = 8
+N_LAYER = 6
+DROPOUT = 0.1
+MAX_LENGTH = 256
+SRC_VOCAB = 10000
+TRG_VOCAB = 10000
+PAD_IDX = 0
+EOS_IDX = 1
+BOS_IDX = 2
+
+
+def _position_encoding_table(max_len, d_model):
+    """Sinusoid table (reference transformer_model.py position_encoding_init)."""
+    pos = np.arange(max_len, dtype=np.float64)[:, None]
+    inv = 1.0 / np.power(10000.0, (np.arange(d_model) // 2 * 2.0) / d_model)
+    ang = pos * inv[None, :]
+    table = np.zeros((max_len, d_model), dtype=np.float32)
+    table[:, 0::2] = np.sin(ang[:, 0::2])
+    table[:, 1::2] = np.cos(ang[:, 1::2])
+    return table
+
+
+def _causal_bias_table(max_len):
+    """[max_len, max_len] upper-triangular -1e9 mask, sliced per sequence."""
+    return np.triu(np.full((max_len, max_len), -1e9, dtype=np.float32), k=1)
+
+
+def _const_table(name, array):
+    """A frozen lookup table materialized as a non-trainable parameter; XLA
+    const-folds the slice of it into the attention fusion."""
+    return layers.create_parameter(
+        shape=list(array.shape),
+        dtype="float32",
+        name=name,
+        attr=ParamAttr(
+            name=name, initializer=NumpyArrayInitializer(array), trainable=False
+        ),
+    )
+
+
+def multi_head_attention(
+    queries,
+    keys,
+    values,
+    attn_bias,
+    d_key,
+    d_value,
+    d_model,
+    n_head,
+    dropout_rate=0.0,
+    cache=None,
+):
+    """Reference transformer_model.py:45 multi_head_attention.  [B,T,D] in,
+    [B,T,D] out; heads split via reshape+transpose (layout-only, free on TPU).
+    ``cache`` (dict with 'k','v' variables) enables incremental decode."""
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+
+    q = layers.fc(input=queries, size=d_key * n_head, num_flatten_dims=2, bias_attr=False)
+    k = layers.fc(input=keys, size=d_key * n_head, num_flatten_dims=2, bias_attr=False)
+    v = layers.fc(input=values, size=d_value * n_head, num_flatten_dims=2, bias_attr=False)
+
+    def split_heads(x, d):
+        b, t = x.shape[0], x.shape[1]
+        x = layers.reshape(x=x, shape=[b if b and b > 0 else -1, t, n_head, d])
+        return layers.transpose(x=x, perm=[0, 2, 1, 3])  # [B,H,T,d]
+
+    q = split_heads(q, d_key)
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    if cache is not None:
+        k = cache["k"] = layers.concat([cache["k"], k], axis=2)
+        v = cache["v"] = layers.concat([cache["v"], v], axis=2)
+
+    product = layers.matmul(x=q, y=k, transpose_y=True, alpha=d_key**-0.5)
+    if attn_bias is not None:
+        product = layers.elementwise_add(x=product, y=attn_bias)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate, is_test=False)
+    ctx = layers.matmul(weights, v)  # [B,H,Tq,dv]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    b, t = queries.shape[0], queries.shape[1]
+    ctx = layers.reshape(x=ctx, shape=[b if b and b > 0 else -1, t, n_head * d_value])
+    return layers.fc(input=ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def positionwise_feed_forward(x, d_inner_hid, d_hid, dropout_rate=0.0):
+    """Reference transformer_model.py:167 — two MXU matmuls with fused relu."""
+    hidden = layers.fc(input=x, size=d_inner_hid, num_flatten_dims=2, act="relu")
+    if dropout_rate:
+        hidden = layers.dropout(hidden, dropout_prob=dropout_rate, is_test=False)
+    return layers.fc(input=hidden, size=d_hid, num_flatten_dims=2)
+
+
+def post_process(prev_out, out, dropout_rate=0.0):
+    """Residual add + layer_norm (post-norm, as the reference's
+    post_process_layer cmd='dan': dropout, add, norm)."""
+    if dropout_rate:
+        out = layers.dropout(out, dropout_prob=dropout_rate, is_test=False)
+    if prev_out is not None:
+        out = layers.elementwise_add(x=out, y=prev_out)
+    return layers.layer_norm(out, begin_norm_axis=len(out.shape) - 1)
+
+
+def prepare_encoder_decoder(
+    word_ids, vocab_size, d_model, max_length, dropout_rate, pos_table, word_emb_name
+):
+    """Token embedding * sqrt(d_model) + sinusoid position encoding
+    (reference transformer_model.py:185 prepare_encoder)."""
+    emb = layers.embedding(
+        input=word_ids,
+        size=[vocab_size, d_model],
+        padding_idx=PAD_IDX,
+        param_attr=ParamAttr(name=word_emb_name),
+    )
+    emb = layers.scale(x=emb, scale=d_model**0.5)
+    seq_len = word_ids.shape[1]
+    pos_enc = layers.slice(pos_table, axes=[0], starts=[0], ends=[seq_len])
+    out = layers.elementwise_add(x=emb, y=pos_enc, axis=1)
+    if dropout_rate:
+        out = layers.dropout(out, dropout_prob=dropout_rate, is_test=False)
+    return out
+
+
+def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner, dropout):
+    attn = multi_head_attention(x, None, None, attn_bias, d_key, d_value, d_model, n_head, dropout)
+    x = post_process(x, attn, dropout)
+    ffn = positionwise_feed_forward(x, d_inner, d_model, dropout)
+    return post_process(x, ffn, dropout)
+
+
+def decoder_layer(
+    x, enc_out, slf_bias, dec_enc_bias, n_head, d_key, d_value, d_model, d_inner, dropout, cache=None
+):
+    slf = multi_head_attention(x, None, None, slf_bias, d_key, d_value, d_model, n_head, dropout, cache=cache)
+    x = post_process(x, slf, dropout)
+    cross = multi_head_attention(x, enc_out, None, dec_enc_bias, d_key, d_value, d_model, n_head, dropout)
+    x = post_process(x, cross, dropout)
+    ffn = positionwise_feed_forward(x, d_inner, d_model, dropout)
+    return post_process(x, ffn, dropout)
+
+
+def _pad_bias(word_ids):
+    """[B,1,1,T] additive bias: -1e9 at pad positions, computed in-graph."""
+    pad = layers.fill_constant(shape=[1], dtype=word_ids.dtype, value=PAD_IDX)
+    is_pad = layers.cast(layers.equal(word_ids, pad), "float32")
+    bias = layers.scale(x=is_pad, scale=-1e9)
+    return layers.unsqueeze(bias, axes=[1, 2])
+
+
+def wrap_encoder(
+    src_word,
+    src_vocab_size=SRC_VOCAB,
+    max_length=MAX_LENGTH,
+    n_layer=N_LAYER,
+    n_head=N_HEAD,
+    d_model=D_MODEL,
+    d_inner=D_INNER,
+    dropout=DROPOUT,
+):
+    pos_table = _const_table("src_pos_enc_table", _position_encoding_table(max_length, d_model))
+    src_bias = _pad_bias(src_word)
+    x = prepare_encoder_decoder(src_word, src_vocab_size, d_model, max_length, dropout, pos_table, "src_word_emb")
+    for _ in range(n_layer):
+        x = encoder_layer(x, src_bias, n_head, d_model // n_head, d_model // n_head, d_model, d_inner, dropout)
+    return x, src_bias
+
+
+def wrap_decoder(
+    trg_word,
+    enc_out,
+    src_bias,
+    trg_vocab_size=TRG_VOCAB,
+    max_length=MAX_LENGTH,
+    n_layer=N_LAYER,
+    n_head=N_HEAD,
+    d_model=D_MODEL,
+    d_inner=D_INNER,
+    dropout=DROPOUT,
+    caches=None,
+    causal=True,
+):
+    pos_table = _const_table("trg_pos_enc_table", _position_encoding_table(max_length, d_model))
+    seq_len = trg_word.shape[1]
+    slf_bias = _pad_bias(trg_word)  # [B,1,1,T]
+    if causal:
+        causal_table = _const_table("causal_bias_table", _causal_bias_table(max_length))
+        causal_bias = layers.slice(causal_table, axes=[0, 1], starts=[0, 0], ends=[seq_len, seq_len])
+        causal_bias = layers.unsqueeze(causal_bias, axes=[0, 1])  # [1,1,T,T]
+        slf_bias = layers.elementwise_add(x=causal_bias, y=slf_bias)
+    x = prepare_encoder_decoder(trg_word, trg_vocab_size, d_model, max_length, dropout, pos_table, "trg_word_emb")
+    for i in range(n_layer):
+        x = decoder_layer(
+            x,
+            enc_out,
+            slf_bias,
+            src_bias,
+            n_head,
+            d_model // n_head,
+            d_model // n_head,
+            d_model,
+            d_inner,
+            dropout,
+            cache=caches[i] if caches is not None else None,
+        )
+    logits = layers.fc(input=x, size=trg_vocab_size, num_flatten_dims=2, bias_attr=False)
+    return logits
+
+
+def transformer(
+    src_word,
+    trg_word,
+    lbl_word,
+    src_vocab_size=SRC_VOCAB,
+    trg_vocab_size=TRG_VOCAB,
+    max_length=MAX_LENGTH,
+    n_layer=N_LAYER,
+    n_head=N_HEAD,
+    d_model=D_MODEL,
+    d_inner=D_INNER,
+    dropout=DROPOUT,
+    label_smooth_eps=0.1,
+):
+    """Training graph (reference transformer_model.py:282 transformer).
+    Returns (avg_cost, sum_cost, token_count, logits)."""
+    enc_out, src_bias = wrap_encoder(src_word, src_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout)
+    logits = wrap_decoder(trg_word, enc_out, src_bias, trg_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout)
+
+    label = layers.one_hot(input=lbl_word, depth=trg_vocab_size)
+    if label_smooth_eps:
+        label = layers.label_smooth(label=label, epsilon=label_smooth_eps)
+    cost = layers.softmax_with_cross_entropy(logits=logits, label=label, soft_label=True)  # [B,T,1]
+
+    pad = layers.fill_constant(shape=[1], dtype=lbl_word.dtype, value=PAD_IDX)
+    non_pad = layers.cast(layers.logical_not(layers.equal(lbl_word, pad)), "float32")
+    weights = layers.unsqueeze(non_pad, axes=[2])
+    weighted = layers.elementwise_mul(x=cost, y=weights)
+    sum_cost = layers.reduce_sum(weighted)
+    token_num = layers.reduce_sum(weights)
+    token_num.stop_gradient = True
+    avg_cost = layers.elementwise_div(x=sum_cost, y=token_num)
+    return avg_cost, sum_cost, token_num, logits
+
+
+def get_model(
+    batch_size=32,
+    seq_len=64,
+    src_vocab_size=SRC_VOCAB,
+    trg_vocab_size=TRG_VOCAB,
+    max_length=MAX_LENGTH,
+    n_layer=N_LAYER,
+    n_head=N_HEAD,
+    d_model=D_MODEL,
+    d_inner=D_INNER,
+    dropout=DROPOUT,
+    learning_rate=2.0,
+    warmup_steps=8000,
+):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src_word = layers.data(name="src_word", shape=[seq_len], dtype="int64")
+        trg_word = layers.data(name="trg_word", shape=[seq_len], dtype="int64")
+        lbl_word = layers.data(name="lbl_word", shape=[seq_len], dtype="int64")
+        avg_cost, sum_cost, token_num, logits = transformer(
+            src_word, trg_word, lbl_word,
+            src_vocab_size, trg_vocab_size, max_length,
+            n_layer, n_head, d_model, d_inner, dropout,
+        )
+        inference_program = main.clone(for_test=True)
+        lr = layers.scale(x=layers.noam_decay(d_model, warmup_steps), scale=float(learning_rate))
+        opt = optim.AdamOptimizer(learning_rate=lr, beta1=0.9, beta2=0.98, epsilon=1e-9)
+        opt.minimize(avg_cost)
+    return {
+        "main": main,
+        "startup": startup,
+        "test": inference_program,
+        "feeds": ["src_word", "trg_word", "lbl_word"],
+        "loss": avg_cost,
+        "sum_cost": sum_cost,
+        "token_num": token_num,
+        "predict": logits,
+    }

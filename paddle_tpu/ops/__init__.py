@@ -8,3 +8,4 @@ from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import decode_ops  # noqa: F401

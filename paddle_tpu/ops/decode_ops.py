@@ -1,0 +1,103 @@
+"""Beam-search decode ops.
+
+Reference kernels: paddle/fluid/operators/beam_search_op.cc and
+beam_search_decode_op.cc.  The reference threads beam parenthood through
+LoD levels on dynamically-sized selected-id tensors; that is hostile to XLA
+(shapes change every step, per-beam host loops).  TPU-native contract:
+
+- The beam dimension is STATIC: every tensor is laid out ``[batch, beam]``
+  (+ trailing candidate axis).  A "dead" beam is just a lane whose score is
+  ``-1e9``; a finished beam keeps emitting ``end_id`` with a frozen score.
+- ``beam_search`` is one fused topk over the flattened ``beam*K`` candidate
+  axis — no LoD, no host roundtrip, differentiable-adjacent ops all stay on
+  device and fuse into the decoder step's XLA computation.
+- Beam parenthood is an explicit ``parent_idx [batch, beam]`` output (the
+  reference encodes it implicitly in the selected-ids LoD); the backtrace in
+  ``beam_search_decode`` is a reversed ``lax.scan`` over the stacked
+  per-step arrays.
+"""
+from __future__ import annotations
+
+from ..registry import register
+
+
+@register("beam_search")
+def _beam_search(ctx, op):
+    import jax.numpy as jnp
+
+    pre_ids = ctx.get_input(op, "pre_ids")  # [B, beam] int
+    pre_scores = ctx.get_input(op, "pre_scores")  # [B, beam] f32
+    ids = ctx.get_input(op, "ids")  # [B, beam, K] int candidate ids
+    scores = ctx.get_input(op, "scores")  # [B, beam, K] accumulated log-probs
+    beam_size = int(op.attrs["beam_size"])
+    end_id = int(op.attrs["end_id"])
+
+    B, beam, K = ids.shape
+    finished = pre_ids == end_id  # [B, beam]
+
+    # Finished beams contribute exactly one candidate: (end_id, frozen score)
+    # in slot k=0; everything else is masked to the floor.
+    neg = jnp.asarray(-1e9, dtype=scores.dtype)
+    slot0 = jnp.arange(K) == 0  # [K]
+    cand_scores = jnp.where(
+        finished[..., None], jnp.where(slot0, pre_scores[..., None], neg), scores
+    )
+    cand_ids = jnp.where(finished[..., None], jnp.asarray(end_id, dtype=ids.dtype), ids)
+
+    import jax.lax as lax
+
+    flat_scores = cand_scores.reshape(B, beam * K)
+    sel_scores, flat_idx = lax.top_k(flat_scores, beam_size)  # [B, beam]
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(B, beam * K), flat_idx, axis=1)
+    parent_idx = (flat_idx // K).astype("int32")
+
+    ctx.set_output(op, "selected_ids", sel_ids)
+    ctx.set_output(op, "selected_scores", sel_scores)
+    ctx.set_output(op, "parent_idx", parent_idx)
+
+
+@register("beam_search_decode")
+def _beam_search_decode(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    ids_name = op.inputs["Ids"][0]
+    parents_name = op.inputs["Parents"][0]
+    scores_name = op.inputs["Scores"][0]
+    end_id = int(op.attrs["end_id"])
+
+    ids_buf = ctx.get(ids_name + "@ARRAY")  # [T_cap, B, beam]
+    parents_buf = ctx.get(parents_name + "@ARRAY")
+    scores_buf = ctx.get(scores_name + "@ARRAY")
+    n = ctx.get(ids_name + "@ARRAYLEN")  # int32 number of valid steps
+
+    T = ids_buf.shape[0]
+    B, beam = ids_buf.shape[1], ids_buf.shape[2]
+
+    # Steps >= n are padding: treat them as "every beam emits end_id and
+    # keeps its own lane" so the backtrace passes through untouched.
+    step_valid = jnp.arange(T) < n  # [T]
+    lane = jnp.broadcast_to(jnp.arange(beam, dtype=parents_buf.dtype), (B, beam))
+    ids_fixed = jnp.where(step_valid[:, None, None], ids_buf, end_id)
+    parents_fixed = jnp.where(step_valid[:, None, None], parents_buf, lane)
+
+    # Reverse backtrace: at the last valid step every lane is its own leaf;
+    # walking backwards, lane j's token at step t is ids[t, b, path_t[j]]
+    # and its parent lane at t-1 is parents[t, b, path_t[j]].
+    def back(path, step):
+        step_ids, step_parents = step
+        tok = jnp.take_along_axis(step_ids, path, axis=1)  # [B, beam]
+        prev = jnp.take_along_axis(step_parents, path, axis=1)
+        return prev.astype(path.dtype), tok
+
+    init_path = jnp.broadcast_to(jnp.arange(beam), (B, beam)).astype("int32")
+    _, toks_rev = jax.lax.scan(
+        back, init_path, (ids_fixed[::-1], parents_fixed[::-1].astype("int32"))
+    )
+    sentence_ids = jnp.moveaxis(toks_rev[::-1], 0, -1)  # [B, beam, T]
+
+    # Final per-lane scores: read the last valid step's scores.
+    last = jnp.clip(n - 1, 0, T - 1)
+    sentence_scores = scores_buf[last]  # [B, beam]
+    ctx.set_output(op, "SentenceIds", sentence_ids)
+    ctx.set_output(op, "SentenceScores", sentence_scores)

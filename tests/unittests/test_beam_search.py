@@ -1,0 +1,118 @@
+"""Beam search op semantics + backtrace decode.
+
+Mirrors the reference's test_beam_search_op.py / test_beam_search_decode_op.py
+intent on the TPU-native static [batch, beam] layout (ops/decode_ops.py).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_beam_search_step():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = layers.data(name="pre_ids", shape=[2], dtype="int64")
+        pre_scores = layers.data(name="pre_scores", shape=[2], dtype="float32")
+        ids = layers.data(name="ids", shape=[2, 2], dtype="int64")
+        scores = layers.data(name="scores", shape=[2, 2], dtype="float32")
+        sel_ids, sel_scores, parents = layers.beam_search(
+            pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # batch=1, beam=2: lane0 candidates (7:-0.5, 8:-2.0), lane1 (9:-1.0, 4:-3.0)
+    out_ids, out_scores, out_par = exe.run(
+        main,
+        feed={
+            "pre_ids": np.array([[5, 6]], dtype=np.int64),
+            "pre_scores": np.array([[-0.1, -0.2]], dtype=np.float32),
+            "ids": np.array([[[7, 8], [9, 4]]], dtype=np.int64),
+            "scores": np.array([[[-0.5, -2.0], [-1.0, -3.0]]], dtype=np.float32),
+        },
+        fetch_list=[sel_ids, sel_scores, parents],
+    )
+    assert out_ids.tolist() == [[7, 9]]
+    np.testing.assert_allclose(out_scores, [[-0.5, -1.0]], rtol=1e-6)
+    assert out_par.tolist() == [[0, 1]]
+
+
+def test_beam_search_finished_beam_frozen():
+    """A lane already at end_id must survive with its frozen score and emit
+    end_id again (reference beam_search_op.cc end-id handling)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = layers.data(name="pre_ids", shape=[2], dtype="int64")
+        pre_scores = layers.data(name="pre_scores", shape=[2], dtype="float32")
+        ids = layers.data(name="ids", shape=[2, 2], dtype="int64")
+        scores = layers.data(name="scores", shape=[2, 2], dtype="float32")
+        sel_ids, sel_scores, parents = layers.beam_search(
+            pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # lane0 finished (id 0, score -0.3); lane1 alive with candidates
+    out_ids, out_scores, out_par = exe.run(
+        main,
+        feed={
+            "pre_ids": np.array([[0, 6]], dtype=np.int64),
+            "pre_scores": np.array([[-0.3, -0.2]], dtype=np.float32),
+            "ids": np.array([[[7, 8], [9, 4]]], dtype=np.int64),
+            "scores": np.array([[[-0.5, -2.0], [-0.9, -3.0]]], dtype=np.float32),
+        },
+        fetch_list=[sel_ids, sel_scores, parents],
+    )
+    # survivors: frozen lane0 (end_id, -0.3) and lane1's best (9, -0.9)
+    assert out_ids.tolist() == [[0, 9]]
+    np.testing.assert_allclose(out_scores, [[-0.3, -0.9]], rtol=1e-6)
+    assert out_par.tolist() == [[0, 1]]
+
+
+def test_beam_search_decode_backtrace():
+    """Write 2 scripted steps into arrays and check the backtrace crosses
+    parent lanes correctly."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step0_ids = layers.data(name="s0i", shape=[2], dtype="int64")
+        step0_par = layers.data(name="s0p", shape=[2], dtype="int32")
+        step0_sc = layers.data(name="s0s", shape=[2], dtype="float32")
+        step1_ids = layers.data(name="s1i", shape=[2], dtype="int64")
+        step1_par = layers.data(name="s1p", shape=[2], dtype="int32")
+        step1_sc = layers.data(name="s1s", shape=[2], dtype="float32")
+
+        ids_arr = layers.create_array("int64", capacity=4)
+        sc_arr = layers.create_array("float32", capacity=4)
+        par_arr = layers.create_array("int32", capacity=4)
+        zero = layers.zeros(shape=[1], dtype="int64")
+        one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        layers.array_write(step0_ids, zero, ids_arr)
+        layers.array_write(step0_sc, zero, sc_arr)
+        layers.array_write(step0_par, zero, par_arr)
+        layers.array_write(step1_ids, one, ids_arr)
+        layers.array_write(step1_sc, one, sc_arr)
+        layers.array_write(step1_par, one, par_arr)
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, sc_arr, par_arr, beam_size=2, end_id=0
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out_ids, out_scores = exe.run(
+        main,
+        feed={
+            # step0 tokens [10, 11]; step1 tokens [12, 13] where BOTH step-1
+            # lanes descend from step-0 lane 1
+            "s0i": np.array([[10, 11]], dtype=np.int64),
+            "s0p": np.array([[0, 1]], dtype=np.int32),
+            "s0s": np.array([[-0.1, -0.2]], dtype=np.float32),
+            "s1i": np.array([[12, 13]], dtype=np.int64),
+            "s1p": np.array([[1, 1]], dtype=np.int32),
+            "s1s": np.array([[-0.4, -0.6]], dtype=np.float32),
+        },
+        fetch_list=[sent_ids, sent_scores],
+    )
+    # lane0 sentence: parent chain 1 -> token 11 then 12; positions past the
+    # 2 written steps are end_id padding (static [B, beam, capacity] layout)
+    assert out_ids[0, 0].tolist() == [11, 12, 0, 0]
+    assert out_ids[0, 1].tolist() == [11, 13, 0, 0]
+    np.testing.assert_allclose(out_scores[0], [-0.4, -0.6], rtol=1e-6)

@@ -1,0 +1,78 @@
+"""Transformer + attention-seq2seq model smoke tests
+(reference: test_machine_translation.py, test_parallel_executor_transformer.py).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import machine_translation as MT
+from paddle_tpu.models import transformer as T
+
+
+def _feed(rng, vocab, b, s, pad, pad_from):
+    x = rng.randint(3, vocab, size=(b, s)).astype("int64")
+    x[:, pad_from:] = pad
+    return x
+
+
+def test_transformer_trains():
+    m = T.get_model(
+        batch_size=4, seq_len=12, src_vocab_size=50, trg_vocab_size=50,
+        max_length=16, n_layer=2, n_head=4, d_model=32, d_inner=64,
+        dropout=0.0, learning_rate=0.05, warmup_steps=4,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(m["startup"])
+    rng = np.random.RandomState(0)
+    src = _feed(rng, 50, 4, 12, T.PAD_IDX, 9)
+    trg = _feed(rng, 50, 4, 12, T.PAD_IDX, 10)
+    lbl = _feed(rng, 50, 4, 12, T.PAD_IDX, 10)
+    losses = []
+    for _ in range(8):
+        out = exe.run(
+            m["main"],
+            feed={"src_word": src, "trg_word": trg, "lbl_word": lbl},
+            fetch_list=[m["loss"]],
+        )
+        losses.append(float(out[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_seq2seq_attention_trains():
+    m = MT.get_model(
+        batch_size=4, seq_len=8, embedding_dim=16, encoder_size=16,
+        decoder_size=16, dict_size=40, learning_rate=0.01,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(m["startup"])
+    rng = np.random.RandomState(0)
+    src = _feed(rng, 40, 4, 8, MT.PAD_IDX, 6)
+    trg = _feed(rng, 40, 4, 8, MT.PAD_IDX, 6)
+    lbl = _feed(rng, 40, 4, 8, MT.PAD_IDX, 6)[..., None]
+    losses = []
+    for _ in range(10):
+        out = exe.run(
+            m["main"],
+            feed={"src_word": src, "trg_word": trg, "label": lbl},
+            fetch_list=[m["loss"]],
+        )
+        losses.append(float(out[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_seq2seq_beam_search_generates():
+    g = MT.get_model(
+        batch_size=4, seq_len=8, embedding_dim=16, encoder_size=16,
+        decoder_size=16, dict_size=40, is_generating=True,
+        beam_size=3, max_length=6,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(g["startup"])
+    rng = np.random.RandomState(0)
+    src = _feed(rng, 40, 4, 8, MT.PAD_IDX, 6)
+    ids, scores = exe.run(g["main"], feed={"src_word": src}, fetch_list=[g["ids"], g["scores"]])
+    assert ids.shape == (4, 3, 6)
+    assert scores.shape == (4, 3)
+    # beams are sorted best-first
+    assert np.all(np.diff(scores, axis=1) <= 1e-5)
+    # all generated ids are valid vocab entries
+    assert ids.min() >= 0 and ids.max() < 40
