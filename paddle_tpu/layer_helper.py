@@ -119,13 +119,20 @@ class LayerHelper:
         attr.initializer(twin, sb)
         return param
 
-    def create_variable_for_type_inference(self, dtype, shape=None, stop_gradient=False):
+    def get_parameter(self, name):
+        param = self.main_program.global_block().vars.get(name)
+        if not isinstance(param, Parameter):
+            raise ValueError("no parameter named %r" % (name,))
+        return param
+
+    def create_variable_for_type_inference(self, dtype, shape=None, stop_gradient=False, lod_level=None):
         return self.block.create_var(
             name=unique_name.generate(".".join([self.name, "tmp"])),
             dtype=dtype,
             shape=shape,
             persistable=False,
             stop_gradient=stop_gradient,
+            lod_level=lod_level or 0,
         )
 
     # older reference spelling

@@ -16,6 +16,14 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "fc",
+    "warpctc",
+    "ctc_greedy_decoder",
+    "edit_distance",
+    "linear_chain_crf",
+    "crf_decoding",
+    "chunk_eval",
+    "nce",
+    "hsigmoid",
     "beam_search",
     "beam_search_decode",
     "embedding",
@@ -1295,7 +1303,14 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
     pad_ = padding if isinstance(padding, (list, tuple)) else [padding] * 4
     if len(pad_) == 2:
         pad_ = list(pad_) * 2
-    out = helper.create_variable_for_type_inference(dtype=input.dtype, lod_level=1)
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 4:
+        n, c, h, w = input.shape
+        if h is not None and w is not None and h > 0 and w > 0:
+            oh = (h + pad_[0] + pad_[2] - fsize[0]) // stride_[0] + 1
+            ow = (w + pad_[1] + pad_[3] - fsize[1]) // stride_[1] + 1
+            out_shape = [n, oh * ow, c * fsize[0] * fsize[1]]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=out_shape, lod_level=1)
     helper.append_op(
         type="im2sequence",
         inputs={"X": [input]},
@@ -1373,3 +1388,221 @@ def beam_search_decode(ids, scores, parents, beam_size, end_id, name=None):
         attrs={"beam_size": beam_size, "end_id": end_id},
     )
     return sentence_ids, sentence_scores
+
+
+# ---------------------------------------------------------------------------
+# structured prediction: CTC / CRF / chunk_eval / NCE / hsigmoid
+# (reference nn.py: warpctc:3587, edit_distance:3486, ctc_greedy_decoder:3532,
+#  linear_chain_crf:1019, crf_decoding:1073, chunk_eval:1155, nce:4104,
+#  hsigmoid:4186)
+# ---------------------------------------------------------------------------
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, name=None):
+    """CTC loss (reference nn.py:3587).  ``input`` holds unscaled logits
+    ``[batch, max_time, num_classes + 1]`` (padded+lengths, vs the
+    reference's LoD layout); ``label`` is ``[batch, max_label_len]`` int.
+    Returns per-sequence loss ``[batch, 1]``."""
+    helper = LayerHelper("warpctc", **locals())
+    loss = helper.create_variable_for_type_inference(
+        dtype=input.dtype, shape=[input.shape[0] if input.shape else -1, 1]
+    )
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label]},
+        outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode (reference nn.py:3532): argmax per frame, then
+    merge repeats and drop blanks (ctc_align op)."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    _, topk_indices = topk(input, k=1)
+    argmax = squeeze(topk_indices, axes=[-1])
+    out = helper.create_variable_for_type_inference(
+        dtype="int64", shape=list(argmax.shape) if argmax.shape else None, stop_gradient=True
+    )
+    helper.append_op(
+        type="ctc_align",
+        inputs={"Input": [argmax]},
+        outputs={"Output": [out]},
+        attrs={"blank": blank, "merge_repeated": True},
+    )
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None, name=None):
+    """Levenshtein distance between hyp and ref id sequences (reference
+    nn.py:3486).  Returns ``(distance [batch, 1], seq_num scalar)``."""
+    helper = LayerHelper("edit_distance", **locals())
+    if ignored_tokens is not None and len(ignored_tokens) > 0:
+        erased_input = helper.create_variable_for_type_inference(dtype=input.dtype, shape=input.shape)
+        erased_label = helper.create_variable_for_type_inference(dtype=label.dtype, shape=label.shape)
+        helper.append_op(
+            type="sequence_erase",
+            inputs={"X": [input]},
+            outputs={"Out": [erased_input]},
+            attrs={"tokens": list(ignored_tokens)},
+        )
+        helper.append_op(
+            type="sequence_erase",
+            inputs={"X": [label]},
+            outputs={"Out": [erased_label]},
+            attrs={"tokens": list(ignored_tokens)},
+        )
+        input, label = erased_input, erased_label
+    out = helper.create_variable_for_type_inference(
+        dtype="float32", shape=[input.shape[0] if input.shape else -1, 1], stop_gradient=True
+    )
+    seq_num = helper.create_variable_for_type_inference(dtype="int32", shape=[], stop_gradient=True)
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized},
+    )
+    return out, seq_num
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF NLL cost (reference nn.py:1019).  Creates the
+    ``[size + 2, size]`` transition parameter (rows 0/1 = start/end weights)
+    and returns the per-sequence negative log-likelihood ``[batch, 1]``."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=helper.input_dtype()
+    )
+    alpha = helper.create_variable_for_type_inference(dtype=helper.input_dtype(), shape=input.shape)
+    log_likelihood = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype(), shape=[input.shape[0] if input.shape else -1, 1]
+    )
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition], "Label": [label]},
+        outputs={"Alpha": [alpha], "LogLikelihood": [log_likelihood]},
+        attrs={},
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with a trained CRF transition param (reference
+    nn.py:1073).  With ``label``, returns per-position 0/1 correctness."""
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference(
+        dtype="int64", shape=list(input.shape[:-1]) if input.shape else None, stop_gradient=True
+    )
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs, outputs={"ViterbiPath": [viterbi_path]})
+    return viterbi_path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, excluded_chunk_types=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference
+    nn.py:1155).  Returns (precision, recall, f1, num_infer_chunks,
+    num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference(dtype="float32", shape=[], stop_gradient=True)
+    recall = helper.create_variable_for_type_inference(dtype="float32", shape=[], stop_gradient=True)
+    f1_score = helper.create_variable_for_type_inference(dtype="float32", shape=[], stop_gradient=True)
+    num_infer_chunks = helper.create_variable_for_type_inference(dtype="int32", shape=[], stop_gradient=True)
+    num_label_chunks = helper.create_variable_for_type_inference(dtype="int32", shape=[], stop_gradient=True)
+    num_correct_chunks = helper.create_variable_for_type_inference(dtype="int32", shape=[], stop_gradient=True)
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={
+            "Precision": [precision],
+            "Recall": [recall],
+            "F1-Score": [f1_score],
+            "NumInferChunks": [num_infer_chunks],
+            "NumLabelChunks": [num_label_chunks],
+            "NumCorrectChunks": [num_correct_chunks],
+        },
+        attrs={
+            "num_chunk_types": num_chunk_types,
+            "chunk_scheme": chunk_scheme,
+            "excluded_chunk_types": excluded_chunk_types or [],
+        },
+    )
+    return precision, recall, f1_score, num_infer_chunks, num_label_chunks, num_correct_chunks
+
+
+def nce(
+    input,
+    label,
+    num_total_classes,
+    sample_weight=None,
+    param_attr=None,
+    bias_attr=None,
+    num_neg_samples=None,
+    name=None,
+):
+    """Noise-contrastive estimation loss (reference nn.py:4104).  Weight is
+    ``[num_total_classes, dim]``; negatives drawn uniformly on device."""
+    helper = LayerHelper("nce", **locals())
+    dim = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_total_classes, dim], dtype=input.dtype
+    )
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[num_total_classes, 1], dtype=input.dtype, is_bias=True
+        )
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    if num_neg_samples is None:
+        num_neg_samples = 10
+    cost = helper.create_variable_for_type_inference(
+        dtype=input.dtype, shape=[input.shape[0] if input.shape else -1, 1]
+    )
+    sample_logits = helper.create_variable_for_type_inference(dtype=input.dtype)
+    sample_labels = helper.create_variable_for_type_inference(dtype=label.dtype, stop_gradient=True)
+    helper.append_op(
+        type="nce",
+        inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits], "SampleLabels": [sample_labels]},
+        attrs={
+            "num_total_classes": int(num_total_classes),
+            "num_neg_samples": int(num_neg_samples),
+        },
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None, name=None):
+    """Hierarchical sigmoid cost over a complete binary class tree
+    (reference nn.py:4186).  Returns ``[batch, 1]``."""
+    helper = LayerHelper("hsigmoid", **locals())
+    dim = input.shape[1]
+    if num_classes < 2:
+        raise ValueError("num_classes must be >= 2, got %r" % (num_classes,))
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes - 1, dim], dtype=input.dtype
+    )
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, num_classes - 1], dtype=input.dtype, is_bias=True
+        )
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(
+        dtype=input.dtype, shape=[input.shape[0] if input.shape else -1, 1]
+    )
+    pre_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": int(num_classes)},
+    )
+    return out

@@ -9,3 +9,6 @@ from . import vgg  # noqa: F401
 from . import resnet  # noqa: F401
 from . import se_resnext  # noqa: F401
 from . import stacked_dynamic_lstm  # noqa: F401
+from . import machine_translation  # noqa: F401
+from . import transformer  # noqa: F401
+from . import ocr_crnn_ctc  # noqa: F401

@@ -1,0 +1,501 @@
+"""Structured-prediction op lowerings: CTC, edit distance, linear-chain CRF,
+chunk evaluation, NCE and hierarchical sigmoid.
+
+Reference kernels: paddle/fluid/operators/{warpctc_op.h, ctc_align_op.h,
+edit_distance_op.h, linear_chain_crf_op.h, crf_decoding_op.h,
+chunk_eval_op.h, nce_op.h, hierarchical_sigmoid_op.h}.
+
+TPU-native design notes:
+- The reference computes CTC via the warp-ctc CUDA library and CRF on CPU
+  with per-sequence loops over LoD slices.  Here everything is a dense,
+  masked, batch-vectorized computation on the padded+lengths layout:
+  CTC is optax's log-semiring forward recursion (a `lax.scan` over time),
+  CRF forward/Viterbi are `lax.scan`s in log space, and edit distance is a
+  scan over hypothesis tokens with a `cummin` min-plus prefix along the
+  reference axis — no data-dependent shapes, everything jits onto the MXU/VPU.
+- Gradients come from JAX autodiff of the forward recursion (the VJP of
+  logsumexp IS the CRF marginal recursion), so no hand-written backward
+  kernels are needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _seq_lengths(ctx, op, slot, x):
+    jnp = _jnp()
+    name = op.inputs[slot][0]
+    lens = ctx.get_lengths(name)
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+    return lens.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+@register("warpctc")
+def _warpctc(ctx, op):
+    """CTC loss (reference operators/warpctc_op.h, which wraps warp-ctc).
+
+    Logits: [B, T, C] padded + lengths; Label: [B, U] padded + lengths.
+    Out Loss: [B, 1] per-sequence negative log-likelihood.
+    """
+    import optax
+
+    jnp = _jnp()
+    logits = ctx.get_input(op, "Logits")
+    labels = ctx.get_input(op, "Label")
+    logit_lens = _seq_lengths(ctx, op, "Logits", logits)
+    label_lens = _seq_lengths(ctx, op, "Label", labels)
+    blank = int(op.attrs.get("blank", 0))
+    norm_by_times = bool(op.attrs.get("norm_by_times", False))
+
+    T = logits.shape[1]
+    U = labels.shape[1]
+    logit_pad = (jnp.arange(T)[None, :] >= logit_lens[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(U)[None, :] >= label_lens[:, None]).astype(jnp.float32)
+    loss = optax.ctc_loss(
+        logits.astype(jnp.float32),
+        logit_pad,
+        labels.astype(jnp.int32),
+        label_pad,
+        blank_id=blank,
+    )
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_lens.astype(jnp.float32), 1.0)
+    ctx.set_output(op, "Loss", loss[:, None])
+
+
+@register("ctc_align")
+def _ctc_align(ctx, op):
+    """CTC greedy-decode alignment (reference operators/ctc_align_op.h):
+    merge repeated tokens, drop blanks; output padded decoded ids + lengths.
+    Static-shape compaction: scatter kept tokens to cumsum positions."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")  # [B, T] int
+    lens = _seq_lengths(ctx, op, "Input", x)
+    blank = int(op.attrs.get("blank", 0))
+    merge_repeated = bool(op.attrs.get("merge_repeated", True))
+
+    x = x.astype(jnp.int32)
+    B, T = x.shape
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    keep = valid & (x != blank)
+    if merge_repeated:
+        prev = jnp.concatenate([jnp.full((B, 1), -1, x.dtype), x[:, :-1]], axis=1)
+        keep = keep & (x != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(keep, pos, T)  # out-of-range -> dropped by scatter
+    out = jnp.zeros((B, T + 1), x.dtype)
+    bidx = jnp.arange(B)[:, None].repeat(T, 1)
+    out = out.at[bidx, pos].set(x, mode="drop")[:, :T]
+    out_lens = keep.astype(jnp.int32).sum(axis=1)
+    name = op.outputs["Output"][0]
+    ctx.set_output(op, "Output", out)
+    ctx.set_lengths(name, out_lens)
+
+
+@register("edit_distance")
+def _edit_distance(ctx, op):
+    """Levenshtein distance (reference operators/edit_distance_op.h).
+
+    DP over hypothesis tokens as a `lax.scan`; the row update's left-to-right
+    dependency (insertions) is a min-plus prefix, computed as
+    ``j + cummin(cand - j)`` — fully vectorized along the reference axis.
+    """
+    import jax
+
+    jnp = _jnp()
+    hyp = ctx.get_input(op, "Hyps").astype(jnp.int32)
+    ref = ctx.get_input(op, "Refs").astype(jnp.int32)
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    hyp_lens = _seq_lengths(ctx, op, "Hyps", hyp)
+    ref_lens = _seq_lengths(ctx, op, "Refs", ref)
+    normalized = bool(op.attrs.get("normalized", True))
+
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    jr = jnp.arange(Tr + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(jr, (B, Tr + 1))
+
+    def step(row, it):
+        i, tok = it  # i: scalar step index, tok: [B]
+        sub_cost = (ref != tok[:, None]).astype(jnp.float32)  # [B, Tr]
+        cand = jnp.minimum(row[:, 1:] + 1.0, row[:, :-1] + sub_cost)
+        cand = jnp.concatenate([jnp.full((B, 1), 1.0) + i, cand], axis=1)
+        new_row = jr[None, :] + jax.lax.cummin(cand - jr[None, :], axis=1)
+        new_row = jnp.minimum(cand, new_row)
+        row = jnp.where((i < hyp_lens)[:, None], new_row, row)
+        return row, None
+
+    its = (jnp.arange(Th, dtype=jnp.float32), hyp.T)
+    row, _ = jax.lax.scan(step, row0, its)
+    dist = jnp.take_along_axis(row, ref_lens[:, None], axis=1)[:, 0]
+    if normalized:
+        dist = dist / jnp.maximum(ref_lens.astype(jnp.float32), 1.0)
+    ctx.set_output(op, "Out", dist[:, None])
+    ctx.set_output(op, "SequenceNum", jnp.asarray(B, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_unpack(w):
+    """Transition param layout (reference linear_chain_crf_op.h): row 0 =
+    start weights, row 1 = end weights, rows 2.. = tag->tag transitions."""
+    return w[0], w[1], w[2:]
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(ctx, op):
+    """Forward algorithm in log space (reference linear_chain_crf_op.h
+    ForwardOneSequence, which works in normalized exp space on CPU).
+    Emission [B, T, K] + lengths, Label [B, T], Transition [K+2, K].
+    LogLikelihood [B, 1] = logZ - score(label path)  (an NLL cost, matching
+    the reference's ``return -ll``)."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "Emission").astype(jnp.float32)
+    w = ctx.get_input(op, "Transition").astype(jnp.float32)
+    y = ctx.get_input(op, "Label").astype(jnp.int32)
+    if y.ndim == 3:
+        y = y[..., 0]
+    lens = _seq_lengths(ctx, op, "Emission", x)
+    B, T, K = x.shape
+    ws, we, A = _crf_unpack(w)
+
+    alpha0 = ws[None, :] + x[:, 0]
+
+    def fwd(alpha, it):
+        t, xt = it
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + A[None], axis=1) + xt
+        alpha = jnp.where((t < lens)[:, None], nxt, alpha)
+        return alpha, alpha
+
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    alpha_last, alphas = jax.lax.scan(fwd, alpha0, (ts, jnp.moveaxis(x, 1, 0)[1:]))
+    log_z = jax.nn.logsumexp(alpha_last + we[None, :], axis=1)
+
+    # label-path score
+    t_idx = jnp.arange(T)[None, :]
+    m = (t_idx < lens[:, None]).astype(jnp.float32)
+    emit = jnp.take_along_axis(x, y[:, :, None], axis=2)[:, :, 0]
+    score = (emit * m).sum(axis=1)
+    trans = A[y[:, :-1], y[:, 1:]]  # [B, T-1]
+    score = score + (trans * m[:, 1:]).sum(axis=1)
+    last = jnp.maximum(lens - 1, 0)
+    y_last = jnp.take_along_axis(y, last[:, None], axis=1)[:, 0]
+    score = score + ws[y[:, 0]] + we[y_last]
+
+    nll = log_z - score
+    nll = jnp.where(lens > 0, nll, 0.0)
+    ctx.set_output(op, "LogLikelihood", nll[:, None])
+    if "Alpha" in op.outputs:
+        full = jnp.concatenate([alpha0[:, None], jnp.moveaxis(alphas, 0, 1)], axis=1)
+        ctx.set_output(op, "Alpha", full)
+
+
+@register("crf_decoding")
+def _crf_decoding(ctx, op):
+    """Viterbi decoding (reference crf_decoding_op.h).  With a Label input the
+    output is per-position 0/1 correctness, exactly like the reference."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "Emission").astype(jnp.float32)
+    w = ctx.get_input(op, "Transition").astype(jnp.float32)
+    lens = _seq_lengths(ctx, op, "Emission", x)
+    B, T, K = x.shape
+    ws, we, A = _crf_unpack(w)
+
+    v0 = ws[None, :] + x[:, 0]
+
+    def fwd(v, it):
+        t, xt = it
+        scores = v[:, :, None] + A[None]  # [B, K_prev, K]
+        bp = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        nv = jnp.max(scores, axis=1) + xt
+        v = jnp.where((t < lens)[:, None], nv, v)
+        return v, bp
+
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    v_last, bps = jax.lax.scan(fwd, v0, (ts, jnp.moveaxis(x, 1, 0)[1:]))
+    final_tag = jnp.argmax(v_last + we[None, :], axis=1).astype(jnp.int32)
+
+    # backtrace: path[L-1] = final_tag; path[t] = bp[t+1][path[t+1]]
+    bidx = jnp.arange(B)
+
+    def back(cur, it):
+        t, bp_t1 = it  # bp for step t+1, [B, K]
+        stepped = bp_t1[bidx, cur]
+        cur = jnp.where(t == lens - 1, final_tag, jnp.where(t < lens - 1, stepped, cur))
+        return cur, cur
+
+    ts_rev = jnp.arange(T - 1, -1, -1, dtype=jnp.int32)
+    pad_bp = jnp.zeros((1, B, K), jnp.int32)
+    bps_ext = jnp.concatenate([bps, pad_bp], axis=0)  # bp for t+1 at index t
+    _, path_rev = jax.lax.scan(back, final_tag, (ts_rev, bps_ext[::-1]))
+    path = path_rev[::-1].T  # [B, T]
+    path = jnp.where(jnp.arange(T)[None, :] < lens[:, None], path, 0)
+
+    if op.inputs.get("Label"):
+        y = ctx.get_input(op, "Label").astype(jnp.int32)
+        if y.ndim == 3:
+            y = y[..., 0]
+        path = (path == y).astype(jnp.int32)
+    name = op.outputs["ViterbiPath"][0]
+    ctx.set_output(op, "ViterbiPath", path.astype(jnp.int64))
+    ctx.set_lengths(name, lens)
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_marks(tag, typ, valid, other, tb, ti, te, tsg):
+    """Vectorized ChunkBegin/ChunkEnd (reference chunk_eval_op.h:83,95).
+
+    begin[i]: a chunk starts at i.  end[i]: a chunk ends at i (i.e. the
+    reference's ChunkEnd(prev=i, cur=i+1), plus the trailing in-chunk case).
+    """
+    jnp = _jnp()
+    B, T = tag.shape
+    neg = jnp.full((B, 1), -1, tag.dtype)
+    oth = jnp.full((B, 1), other, typ.dtype)
+    # positions beyond length behave like Other (no chunk); callers mask typ
+    typ_v = typ
+    ptag = jnp.concatenate([neg, tag[:, :-1]], axis=1)
+    ptyp_v = jnp.concatenate([oth, typ_v[:, :-1]], axis=1)
+
+    def chunk_begin(pt, pty, t, ty):
+        r = jnp.where(
+            pty == other,
+            ty != other,
+            jnp.where(
+                ty == other,
+                False,
+                jnp.where(
+                    ty != pty,
+                    True,
+                    (t == tb)
+                    | ((t == ti) & ((pt == te) | (pt == tsg)))
+                    | ((t == te) & ((pt == te) | (pt == tsg)))
+                    | (t == tsg),
+                ),
+            ),
+        )
+        return r
+
+    def chunk_end(pt, pty, t, ty):
+        r = jnp.where(
+            pty == other,
+            False,
+            jnp.where(
+                ty == other,
+                True,
+                jnp.where(
+                    ty != pty,
+                    True,
+                    jnp.where(
+                        (pt == tb) | (pt == ti),
+                        (t == tb) | (t == tsg),
+                        (pt == te) | (pt == tsg),
+                    ),
+                ),
+            ),
+        )
+        return r
+
+    begin = chunk_begin(ptag, ptyp_v, tag, typ_v) & valid
+    # end at i when cur position i+1 triggers ChunkEnd, or i is last valid pos
+    ntag = jnp.concatenate([tag[:, 1:], neg], axis=1)
+    ntyp_v = jnp.concatenate([typ_v[:, 1:], oth], axis=1)
+    end = chunk_end(tag, typ_v, ntag, ntyp_v) & valid & (typ_v != other)
+    return begin, end
+
+
+@register("chunk_eval")
+def _chunk_eval(ctx, op):
+    """Chunk-level P/R/F1 for sequence labeling (reference chunk_eval_op.h).
+
+    Fully vectorized: a chunk is matched iff both sequences start a chunk at
+    the same position with the same type AND the first chunk-end at/after
+    that position coincides (computed with a reverse cummin over end marks).
+    """
+    import jax
+
+    jnp = _jnp()
+    inf = ctx.get_input(op, "Inference").astype(jnp.int32)
+    lab = ctx.get_input(op, "Label").astype(jnp.int32)
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    lens = _seq_lengths(ctx, op, "Label", lab)
+    scheme = op.attrs.get("chunk_scheme", "IOB")
+    num_chunk_types = int(op.attrs["num_chunk_types"])
+    excluded = list(op.attrs.get("excluded_chunk_types", []) or [])
+    ntt, tb, ti, te, tsg = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types  # reference: tag==num_chunk_types*num_tag_types -> Other
+
+    B, T = lab.shape
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+
+    def marks(seq):
+        tag = seq % ntt
+        typ = jnp.where(valid, seq // ntt, other)
+        if scheme == "plain":
+            tag = jnp.zeros_like(seq)
+            typ = jnp.where(valid, seq, other)
+        return (tag, typ) + _chunk_marks(tag, typ, valid, other, tb, ti, te, tsg)
+
+    tag_i, typ_i, beg_i, end_i = marks(inf)
+    tag_l, typ_l, beg_l, end_l = marks(lab)
+
+    def first_end(end):
+        # first position j >= i with end[j]; T if none
+        idx = jnp.where(end, jnp.arange(T)[None, :], T)
+        rev = jax.lax.cummin(idx[:, ::-1], axis=1)[:, ::-1]
+        return rev
+
+    fe_i, fe_l = first_end(end_i), first_end(end_l)
+
+    def not_excluded(typ):
+        ok = jnp.ones(typ.shape, bool)
+        for e in excluded:
+            ok &= typ != e
+        return ok
+
+    n_inf = (beg_i & not_excluded(typ_i)).astype(jnp.int32).sum()
+    n_lab = (beg_l & not_excluded(typ_l)).astype(jnp.int32).sum()
+    match = beg_i & beg_l & (typ_i == typ_l) & (fe_i == fe_l) & not_excluded(typ_i)
+    n_cor = match.astype(jnp.int32).sum()
+
+    p = n_cor / jnp.maximum(n_inf, 1)
+    r = n_cor / jnp.maximum(n_lab, 1)
+    f1 = jnp.where(n_cor > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    p = jnp.where(n_inf > 0, p, 0.0).astype(jnp.float32)
+    r = jnp.where(n_lab > 0, r, 0.0).astype(jnp.float32)
+    ctx.set_output(op, "Precision", p)
+    ctx.set_output(op, "Recall", r)
+    ctx.set_output(op, "F1-Score", f1.astype(jnp.float32))
+    ctx.set_output(op, "NumInferChunks", n_inf)
+    ctx.set_output(op, "NumLabelChunks", n_lab)
+    ctx.set_output(op, "NumCorrectChunks", n_cor)
+
+
+# ---------------------------------------------------------------------------
+# NCE / hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+
+@register("nce")
+def _nce(ctx, op):
+    """Noise-contrastive estimation (reference nce_op.h).  Uniform negative
+    sampling on-device; cost_true = -log(o/(o+b)), cost_neg = -log(b/(o+b))
+    with b = num_neg_samples / num_total_classes — written in logit space for
+    numerical stability (softplus forms), same math."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input").astype(jnp.float32)  # [B, D]
+    weight = ctx.get_input(op, "Weight").astype(jnp.float32)  # [C, D]
+    label = ctx.get_input(op, "Label").astype(jnp.int32)  # [B, num_true]
+    bias = ctx.get_input(op, "Bias", None)
+    if label.ndim == 1:
+        label = label[:, None]
+    B, num_true = label.shape
+    num_neg = int(op.attrs.get("num_neg_samples", 10))
+    num_classes = int(op.attrs["num_total_classes"])
+    custom_neg = list(op.attrs.get("custom_neg_classes", []) or [])
+
+    if custom_neg:
+        neg = jnp.broadcast_to(jnp.asarray(custom_neg, jnp.int32)[None, :], (B, len(custom_neg)))
+    else:
+        key = ctx.op_key(op, op.attrs.get("seed", 0))
+        neg = jax.random.randint(key, (B, num_neg), 0, num_classes, dtype=jnp.int32)
+    samples = jnp.concatenate([label, neg], axis=1)  # [B, S]
+
+    w = weight[samples]  # [B, S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    b_const = float(num_neg) / float(num_classes)
+    # o = sigmoid(z).  In logit space (stable for saturated z):
+    #   cost_true = -log(o/(o+b))   = logaddexp(log1p(b), log b - z)
+    #   cost_neg  = -log(b/(o+b))   = cost_true - softplus(-z) - log b
+    z = logits
+    u = jnp.logaddexp(np.log1p(b_const), np.log(b_const) - z)
+    cost_true = u[:, :num_true]
+    cost_neg = (u - jax.nn.softplus(-z) - np.log(b_const))[:, num_true:]
+    cost = cost_true.sum(axis=1) + cost_neg.sum(axis=1)
+    o = jax.nn.sigmoid(logits)
+    sw = ctx.get_input(op, "SampleWeight", None)
+    if sw is not None:
+        cost = cost * sw.reshape(-1)
+    ctx.set_output(op, "Cost", cost[:, None])
+    ctx.set_output(op, "SampleLogits", o)
+    ctx.set_output(op, "SampleLabels", samples)
+
+
+@register("hierarchical_sigmoid")
+def _hierarchical_sigmoid(ctx, op):
+    """Hierarchical sigmoid over the implicit complete binary tree
+    (reference hierarchical_sigmoid_op.h + math/matrix_bit_code.h).
+
+    For label l: code c = l + num_classes; bit k uses internal node
+    (c >> (k+1)) - 1 with target bit (c >> k) & 1, for k < FindLastSet(c)-1.
+    Cost = sum_k softplus(preout_k) - bit_k * preout_k, preout clipped to
+    [-40, 40] like the reference.  Out-of-path slots are masked out exactly
+    (the reference leaves a constant log(2) per empty slot; see its TODO at
+    hierarchical_sigmoid_op.h:76 — gradients are identical)."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X").astype(jnp.float32)  # [B, D]
+    w = ctx.get_input(op, "W").astype(jnp.float32)  # [C-1, D]
+    label = ctx.get_input(op, "Label").astype(jnp.int32).reshape(-1)  # [B]
+    bias = ctx.get_input(op, "Bias", None)
+    num_classes = int(op.attrs["num_classes"])
+    max_len = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+
+    c = label + num_classes  # [B]
+    ks = jnp.arange(max_len, dtype=jnp.int32)[None, :]  # [1, L]
+    node = jnp.right_shift(c[:, None], ks + 1) - 1  # [B, L]
+    bit = jnp.bitwise_and(jnp.right_shift(c[:, None], ks), 1).astype(jnp.float32)
+    valid = (jnp.right_shift(c[:, None], ks + 1) > 0).astype(jnp.float32)
+    node = jnp.clip(node, 0, num_classes - 2)
+
+    pre = jnp.einsum("bd,bld->bl", x, w[node])  # [B, L]
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[node]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    cost = (jax.nn.softplus(pre) - bit * pre) * valid
+    ctx.set_output(op, "Out", cost.sum(axis=1)[:, None])
+    ctx.set_output(op, "PreOut", pre * valid)

@@ -1,0 +1,34 @@
+"""CRNN-CTC OCR model smoke: builds, trains a few steps, loss decreases,
+decode/eval path runs (mirrors the reference OCR benchmark usage)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from paddle_tpu.models import ocr_crnn_ctc
+
+
+def test_ocr_crnn_ctc_trains():
+    num_classes = 8
+    model = ocr_crnn_ctc.get_model(
+        data_shape=[1, 16, 96], rnn_hidden_size=16, num_classes=num_classes
+    )
+    rng = np.random.RandomState(0)
+    B = 4
+    imgs = rng.randn(B, 1, 16, 96).astype("float32")
+    labels = pack_sequences(
+        [rng.randint(0, num_classes, size=(L,)).astype("int64") for L in [2, 1, 2, 2]]
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(model["startup"])
+        losses = []
+        for _ in range(12):
+            lv, ev, sn = exe.run(
+                model["main"],
+                feed={"pixel": imgs, "label": labels},
+                fetch_list=[model["loss"], model["error"], model["seq_num"]],
+            )
+            losses.append(float(np.ravel(lv)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+        assert int(sn) == B

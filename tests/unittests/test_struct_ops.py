@@ -1,0 +1,390 @@
+"""Structured-prediction op tests: CTC, edit distance, CRF, chunk_eval,
+NCE, hsigmoid — each checked against an independent reference (torch CTC,
+brute-force path enumeration, plain-Python DP/chunkers), mirroring the
+reference's test_warpctc_op / test_edit_distance_op / test_linear_chain_crf_op
+/ test_crf_decoding_op / test_chunk_eval_op / test_nce / test_hsigmoid_op."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDArray, pack_sequences
+
+
+def _run(build, feeds, scope=None):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = scope or fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed=feeds, fetch_list=list(outs))
+    return res
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+def test_warpctc_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    C = 6  # classes incl. blank 0
+    logit_lens = [7, 5, 6]
+    label_lens = [3, 2, 2]
+    logits = pack_sequences([rng.randn(L, C).astype("float32") for L in logit_lens])
+    labels = pack_sequences(
+        [rng.randint(1, C, size=(L,)).astype("int64") for L in label_lens]
+    )
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[C], lod_level=1, dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], lod_level=1, dtype="int64")
+        return [fluid.layers.warpctc(input=x, label=y, blank=0)]
+
+    (loss,) = _run(build, {"x": logits, "y": labels})
+
+    lp = torch.log_softmax(torch.tensor(logits.data), dim=-1).transpose(0, 1)  # [T,B,C]
+    expected = torch.nn.functional.ctc_loss(
+        lp,
+        torch.tensor(labels.data),
+        torch.tensor(logit_lens),
+        torch.tensor(label_lens),
+        blank=0,
+        reduction="none",
+    ).numpy()
+    np.testing.assert_allclose(loss.reshape(-1), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_greedy_decoder():
+    # frames argmax to [0 1 1 0 2 2 0] -> merge/deblank -> [1, 2]
+    ids = np.array([0, 1, 1, 0, 2, 2, 0])
+    x = np.zeros((1, 7, 3), "float32")
+    x[0, np.arange(7), ids] = 5.0
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[3], lod_level=1, dtype="float32")
+        return [fluid.layers.ctc_greedy_decoder(input=xv, blank=0)]
+
+    (out,) = _run(build, {"x": LoDArray(x, np.array([7], np.int32))})
+    assert list(out[0, :2]) == [1, 2]
+    assert np.all(out[0, 2:] == 0)
+
+
+def _levenshtein(a, b):
+    d = np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = np.arange(len(a) + 1)
+    d[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i, j] = min(
+                d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + (a[i - 1] != b[j - 1])
+            )
+    return d[len(a), len(b)]
+
+
+def test_edit_distance():
+    rng = np.random.RandomState(3)
+    hyp_seqs = [rng.randint(0, 5, size=(L,)).astype("int64") for L in [4, 6, 1, 5]]
+    ref_seqs = [rng.randint(0, 5, size=(L,)).astype("int64") for L in [5, 3, 2, 5]]
+
+    def build():
+        h = fluid.layers.data(name="h", shape=[1], lod_level=1, dtype="int64")
+        r = fluid.layers.data(name="r", shape=[1], lod_level=1, dtype="int64")
+        d, n = fluid.layers.edit_distance(input=h, label=r, normalized=False)
+        dn, _ = fluid.layers.edit_distance(input=h, label=r, normalized=True)
+        return [d, n, dn]
+
+    d, n, dn = _run(build, {"h": pack_sequences(hyp_seqs), "r": pack_sequences(ref_seqs)})
+    expected = np.array([_levenshtein(a, b) for a, b in zip(hyp_seqs, ref_seqs)])
+    np.testing.assert_allclose(d.reshape(-1), expected, rtol=1e-6)
+    np.testing.assert_allclose(
+        dn.reshape(-1), expected / np.array([len(s) for s in ref_seqs]), rtol=1e-6
+    )
+    assert int(n) == 4
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_brute(x, w, y):
+    """Brute-force NLL: logZ - score, enumerating all tag paths."""
+    T, K = x.shape
+    ws, we, A = w[0], w[1], w[2:]
+
+    def score(path):
+        s = ws[path[0]] + x[0, path[0]] + we[path[-1]]
+        for t in range(1, T):
+            s += x[t, path[t]] + A[path[t - 1], path[t]]
+        return s
+
+    logz = np.logaddexp.reduce([score(p) for p in itertools.product(range(K), repeat=T)])
+    return logz - score(y), max(
+        itertools.product(range(K), repeat=T), key=lambda p: score(p)
+    )
+
+
+def test_linear_chain_crf_and_decoding():
+    rng = np.random.RandomState(7)
+    K = 4
+    lens = [3, 2, 4]
+    emissions = [rng.randn(L, K).astype("float32") * 2 for L in lens]
+    labels = [rng.randint(0, K, size=(L,)).astype("int64") for L in lens]
+    w = (rng.randn(K + 2, K) * 0.5).astype("float32")
+
+    scope = fluid.Scope()
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[K], lod_level=1, dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], lod_level=1, dtype="int64")
+        crf = fluid.layers.linear_chain_crf(
+            input=x, label=y, param_attr=fluid.ParamAttr(name="crfw")
+        )
+        decode = fluid.layers.crf_decoding(
+            input=x, param_attr=fluid.ParamAttr(name="crfw")
+        )
+        check = fluid.layers.crf_decoding(
+            input=x, param_attr=fluid.ParamAttr(name="crfw"), label=y
+        )
+        return [crf, decode, check]
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope["crfw"] = w
+        nll, path, check = exe.run(
+            main,
+            feed={"x": pack_sequences(emissions), "y": pack_sequences(labels)},
+            fetch_list=list(outs),
+        )
+
+    for b, L in enumerate(lens):
+        exp_nll, exp_path = _crf_brute(emissions[b], w, labels[b])
+        np.testing.assert_allclose(nll[b, 0], exp_nll, rtol=1e-4)
+        assert list(path[b, :L]) == list(exp_path), (b, path[b, :L], exp_path)
+        np.testing.assert_array_equal(
+            check[b, :L], (np.array(exp_path) == labels[b]).astype("int64")
+        )
+
+
+def test_crf_trains():
+    """CRF NLL decreases under SGD (gradient = autodiff of the forward scan)."""
+    rng = np.random.RandomState(1)
+    K, B, T = 3, 8, 5
+    x = rng.randn(B, T, K).astype("float32")
+    y = rng.randint(0, K, size=(B, T)).astype("int64")
+    lens = np.full((B,), T, np.int32)
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[K], lod_level=1, dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[1], lod_level=1, dtype="int64")
+        crf = fluid.layers.linear_chain_crf(
+            input=xv, label=yv, param_attr=fluid.ParamAttr(name="crfw")
+        )
+        avg = fluid.layers.mean(crf)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(
+                main,
+                feed={"x": LoDArray(x, lens), "y": LoDArray(y, lens)},
+                fetch_list=[avg],
+            )
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+
+def _iob_chunks(tags, num_types):
+    """Extract (begin, end, type) chunks under the IOB scheme."""
+    chunks, start, cur = [], None, None
+    for i, t in enumerate(tags):
+        if t == num_types * 2:  # Other
+            if start is not None:
+                chunks.append((start, i - 1, cur))
+                start = None
+            continue
+        typ, tag = divmod(int(t), 2) if False else (int(t) // 2, int(t) % 2)
+        if tag == 0 or start is None or typ != cur:  # B or broken I
+            if start is not None:
+                chunks.append((start, i - 1, cur))
+            start, cur = i, typ
+    if start is not None:
+        chunks.append((start, len(tags) - 1, cur))
+    return set(chunks)
+
+
+def test_chunk_eval_iob():
+    rng = np.random.RandomState(5)
+    num_types = 3
+    lens = [8, 6, 10]
+    # tags in [0, 2*num_types]: 2t=B-t, 2t+1=I-t, 6=O
+    lab = [rng.randint(0, 2 * num_types + 1, size=(L,)).astype("int64") for L in lens]
+    inf = [rng.randint(0, 2 * num_types + 1, size=(L,)).astype("int64") for L in lens]
+
+    def build():
+        iv = fluid.layers.data(name="i", shape=[1], lod_level=1, dtype="int64")
+        lv = fluid.layers.data(name="l", shape=[1], lod_level=1, dtype="int64")
+        return list(
+            fluid.layers.chunk_eval(
+                input=iv, label=lv, chunk_scheme="IOB", num_chunk_types=num_types
+            )
+        )
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": pack_sequences(inf), "l": pack_sequences(lab)})
+
+    e_ni = e_nl = e_nc = 0
+    for a, b in zip(inf, lab):
+        ca, cb = _iob_chunks(a, num_types), _iob_chunks(b, num_types)
+        e_ni += len(ca)
+        e_nl += len(cb)
+        e_nc += len(ca & cb)
+    assert (int(ni), int(nl), int(nc)) == (e_ni, e_nl, e_nc)
+    np.testing.assert_allclose(float(p), e_nc / max(e_ni, 1), rtol=1e-5)
+    np.testing.assert_allclose(float(r), e_nc / max(e_nl, 1), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# NCE / hsigmoid
+# ---------------------------------------------------------------------------
+
+
+def test_nce_cost_custom_negatives():
+    """Deterministic check via custom_neg_classes (reference test_nce.py)."""
+    rng = np.random.RandomState(11)
+    B, D, C = 4, 5, 8
+    x = rng.randn(B, D).astype("float32")
+    w = rng.randn(C, D).astype("float32")
+    bias = rng.randn(C).astype("float32")
+    label = rng.randint(0, C, size=(B, 1)).astype("int64")
+    negs = [1, 4, 6]
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(
+            input=xv,
+            label=yv,
+            num_total_classes=C,
+            num_neg_samples=len(negs),
+            param_attr=fluid.ParamAttr(name="nce_w"),
+            bias_attr=fluid.ParamAttr(name="nce_b"),
+        )
+        # make the sampler deterministic for the test
+        for op in main.global_block().ops:
+            if op.type == "nce":
+                op.attrs["custom_neg_classes"] = negs
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope()["nce_w"] = w
+        fluid.global_scope()["nce_b"] = bias.reshape(C, 1)
+        (out,) = exe.run(main, feed={"x": x, "y": label}, fetch_list=[cost])
+
+    b_const = len(negs) / C
+    expected = np.zeros(B)
+    for i in range(B):
+        samples = [int(label[i, 0])] + negs
+        for j, s in enumerate(samples):
+            o = 1.0 / (1.0 + np.exp(-(x[i] @ w[s] + bias[s])))
+            expected[i] += -np.log(o / (o + b_const)) if j == 0 else -np.log(
+                b_const / (o + b_const)
+            )
+    np.testing.assert_allclose(out.reshape(-1), expected, rtol=1e-4)
+
+
+def _hsigmoid_ref(x, w, bias, label, num_classes):
+    B = x.shape[0]
+    out = np.zeros(B)
+    for i in range(B):
+        c = int(label[i]) + num_classes
+        length = c.bit_length() - 1
+        for k in range(length):
+            node = (c >> (k + 1)) - 1
+            bit = (c >> k) & 1
+            pre = np.clip(x[i] @ w[node] + bias[node], -40, 40)
+            out[i] += np.log1p(np.exp(pre)) - bit * pre
+    return out
+
+
+def test_hsigmoid():
+    rng = np.random.RandomState(13)
+    B, D, C = 6, 4, 10
+    x = rng.randn(B, D).astype("float32")
+    w = rng.randn(C - 1, D).astype("float32")
+    bias = rng.randn(C - 1).astype("float32")
+    label = rng.randint(0, C, size=(B, 1)).astype("int64")
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.hsigmoid(
+            input=xv,
+            label=yv,
+            num_classes=C,
+            param_attr=fluid.ParamAttr(name="hs_w"),
+            bias_attr=fluid.ParamAttr(name="hs_b"),
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope()["hs_w"] = w
+        fluid.global_scope()["hs_b"] = bias.reshape(1, C - 1)
+        (out,) = exe.run(main, feed={"x": x, "y": label}, fetch_list=[cost])
+
+    expected = _hsigmoid_ref(x, w, bias, label.reshape(-1), C)
+    np.testing.assert_allclose(out.reshape(-1), expected, rtol=1e-4)
+
+
+def test_nce_hsigmoid_train():
+    """Both losses decrease when trained (word2vec-style usage)."""
+    rng = np.random.RandomState(2)
+    B, D, C = 32, 8, 12
+    x = rng.randn(B, D).astype("float32")
+    y = rng.randint(0, C, size=(B, 1)).astype("int64")
+
+    for kind in ("nce", "hsigmoid"):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            yv = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            emb = fluid.layers.fc(input=xv, size=D)
+            if kind == "nce":
+                cost = fluid.layers.nce(input=emb, label=yv, num_total_classes=C, num_neg_samples=4)
+            else:
+                cost = fluid.layers.hsigmoid(input=emb, label=yv, num_classes=C)
+            avg = fluid.layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(40):
+                (lv,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[avg])
+                losses.append(float(np.ravel(lv)[0]))
+        assert losses[-1] < losses[0], (kind, losses[0], losses[-1])
