@@ -12,3 +12,4 @@ from . import stacked_dynamic_lstm  # noqa: F401
 from . import machine_translation  # noqa: F401
 from . import transformer  # noqa: F401
 from . import ocr_crnn_ctc  # noqa: F401
+from . import word2vec  # noqa: F401

@@ -1,0 +1,29 @@
+"""word2vec n-gram LM trains with each head (softmax / NCE / hsigmoid),
+mirroring the reference book test_word2vec.py convergence check."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import word2vec
+
+
+@pytest.mark.parametrize("loss_type", ["softmax", "nce", "hsigmoid"])
+def test_word2vec_trains(loss_type):
+    vocab = 50
+    model = word2vec.get_model(loss_type=loss_type, vocab_size=vocab, emb_size=8,
+                               hidden_size=16, num_neg_samples=4, lr=0.05)
+    rng = np.random.RandomState(0)
+    B = 64
+    ctx = rng.randint(0, vocab, size=(B, 4)).astype("int64")
+    nxt = ((ctx.sum(1) + 1) % vocab).astype("int64").reshape(B, 1)
+    feeds = {n: ctx[:, i:i+1] for i, n in enumerate(["firstw", "secondw", "thirdw", "fourthw"])}
+    feeds["nextw"] = nxt
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(model["startup"])
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(model["main"], feed=feeds, fetch_list=[model["loss"]])
+            losses.append(float(np.ravel(lv)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (loss_type, losses[0], losses[-1])
