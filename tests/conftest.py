@@ -2,8 +2,11 @@
 multi-device sharding tests run without TPU hardware."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")  # name used by this jax build
+# Force cpu even when the ambient env selects the TPU tunnel (JAX_PLATFORMS=axon):
+# unit tests must be hermetic + fast; TPU runs happen via bench.py/drive scripts.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,82 @@
+"""save/load params + inference-model roundtrip tests (mirrors the
+reference's test_io_save_load_ops / book inference-model usage)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build_and_train(scope, steps=5):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu", param_attr=fluid.ParamAttr(name="w1"))
+        pred = fluid.layers.fc(input=h, size=1, param_attr=fluid.ParamAttr(name="w2"))
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 8).astype("float32")
+    yv = rng.randn(32, 1).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[cost])
+    return main, exe, pred, (xv, yv)
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    scope = fluid.Scope()
+    main, exe, pred, _ = _build_and_train(scope)
+    with fluid.scope_guard(scope):
+        w1 = np.asarray(fluid.global_scope()["w1"])
+        fluid.io.save_params(exe, str(tmp_path / "p"), main_program=main)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.io.load_params(exe, str(tmp_path / "p"), main_program=main)
+        np.testing.assert_array_equal(np.asarray(fluid.global_scope()["w1"]), w1)
+
+
+def test_save_load_single_file(tmp_path):
+    scope = fluid.Scope()
+    main, exe, pred, _ = _build_and_train(scope)
+    with fluid.scope_guard(scope):
+        fluid.io.save_persistables(exe, str(tmp_path / "p"), main_program=main, filename="all")
+        w2 = np.asarray(fluid.global_scope()["w2"])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.io.load_persistables(exe, str(tmp_path / "p"), main_program=main, filename="all")
+        np.testing.assert_array_equal(np.asarray(fluid.global_scope()["w2"]), w2)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    scope = fluid.Scope()
+    main, exe, pred, (xv, yv) = _build_and_train(scope)
+    with fluid.scope_guard(scope):
+        (expected,) = exe.run(
+            main.clone(for_test=True), feed={"x": xv, "y": yv}, fetch_list=[pred]
+        )
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe, main_program=main)
+    assert os.path.exists(tmp_path / "m" / "__model__")
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feed_names, fetch_vars = fluid.io.load_inference_model(str(tmp_path / "m"), exe)
+        assert feed_names == ["x"]
+        (got,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_inference_model_prunes_backward(tmp_path):
+    scope = fluid.Scope()
+    main, exe, pred, _ = _build_and_train(scope)
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe, main_program=main)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, _, _ = fluid.io.load_inference_model(str(tmp_path / "m"), exe)
+    types = {op.type for op in prog.global_block().ops}
+    assert "sgd" not in types and "backward" not in types, types
