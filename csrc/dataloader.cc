@@ -1,0 +1,164 @@
+// Threaded shuffling prefetch loader over recordio files
+// (reference analog: paddle/fluid/operators/reader/* double-buffered /
+// multi-file readers + recordio scanner, rebuilt as a host-side C++
+// component that feeds the TPU input pipeline).
+//
+// N reader threads each scan a disjoint subset of the input files, push
+// records into a bounded ring buffer (mutex + condvars); the consumer pops
+// records (optionally shuffle-buffered) and hands bytes to Python via
+// ctypes, where they're decoded and device_put to the TPU.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rio_reader_open(const char* path);
+int rio_reader_next(void* handle, const uint8_t** buf, uint32_t* len);
+void rio_reader_close(void* handle);
+}
+
+namespace {
+
+struct Loader {
+  std::vector<std::string> files;
+  size_t capacity;
+  size_t shuffle_buf;
+  uint64_t seed;
+  int epochs;
+
+  std::deque<std::vector<uint8_t>> queue;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  bool done = false;
+  std::vector<std::thread> producers;
+  std::thread closer;
+  std::vector<uint8_t> current;  // last popped record (stable for ctypes)
+
+  // shuffle pool (consumer side, deterministic given seed)
+  std::vector<std::vector<uint8_t>> pool;
+  std::mt19937_64 rng;
+
+  Loader(std::vector<std::string> files_, size_t capacity_, size_t shuffle_buf_,
+         uint64_t seed_, int epochs_)
+      : files(std::move(files_)),
+        capacity(capacity_ ? capacity_ : 1024),
+        shuffle_buf(shuffle_buf_),
+        seed(seed_),
+        epochs(epochs_ ? epochs_ : 1),
+        rng(seed_) {}
+
+  void producer(size_t tid, size_t nthreads) {
+    for (int e = 0; e < epochs; ++e) {
+      for (size_t i = tid; i < files.size(); i += nthreads) {
+        void* r = rio_reader_open(files[i].c_str());
+        if (!r) continue;
+        const uint8_t* buf;
+        uint32_t len;
+        int rc;
+        while ((rc = rio_reader_next(r, &buf, &len)) == 1) {
+          std::vector<uint8_t> rec(buf, buf + len);
+          std::unique_lock<std::mutex> lk(mu);
+          not_full.wait(lk, [&] { return queue.size() < capacity || done; });
+          if (done) {
+            rio_reader_close(r);
+            return;
+          }
+          queue.push_back(std::move(rec));
+          not_empty.notify_one();
+        }
+        rio_reader_close(r);
+      }
+    }
+  }
+
+  void start(size_t nthreads) {
+    size_t n = nthreads ? nthreads : 1;
+    for (size_t t = 0; t < n; ++t)
+      producers.emplace_back([this, t, n] { producer(t, n); });
+    // closer: mark the stream done once every producer finishes
+    closer = std::thread([this] {
+      for (auto& t : producers) t.join();
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+      not_empty.notify_all();
+    });
+  }
+
+  bool pop_raw(std::vector<uint8_t>* out) {
+    std::unique_lock<std::mutex> lk(mu);
+    not_empty.wait(lk, [&] { return !queue.empty() || done; });
+    if (queue.empty()) return false;
+    *out = std::move(queue.front());
+    queue.pop_front();
+    not_full.notify_one();
+    return true;
+  }
+
+  // 1 = record, 0 = end of stream
+  int next(const uint8_t** buf, uint32_t* len) {
+    if (shuffle_buf > 1) {
+      // keep the pool topped up, then emit a random element
+      std::vector<uint8_t> rec;
+      while (pool.size() < shuffle_buf && pop_raw(&rec)) pool.push_back(std::move(rec));
+      if (pool.empty()) return 0;
+      size_t j = rng() % pool.size();
+      current = std::move(pool[j]);
+      pool[j] = std::move(pool.back());
+      pool.pop_back();
+    } else {
+      if (!pop_raw(&current)) return 0;
+    }
+    *buf = current.data();
+    *len = uint32_t(current.size());
+    return 1;
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+      not_full.notify_all();
+      not_empty.notify_all();
+    }
+    if (closer.joinable()) closer.join();  // closer joins the producers
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\n'-joined file list.
+void* loader_open(const char* paths, uint32_t num_threads, uint32_t capacity,
+                  uint32_t shuffle_buf, uint64_t seed, int epochs) {
+  std::vector<std::string> files;
+  const char* p = paths;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    if (!nl) {
+      files.emplace_back(p);
+      break;
+    }
+    files.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  if (files.empty()) return nullptr;
+  Loader* l = new Loader(std::move(files), capacity, shuffle_buf, seed, epochs);
+  l->start(num_threads);
+  return l;
+}
+
+int loader_next(void* handle, const uint8_t** buf, uint32_t* len) {
+  return static_cast<Loader*>(handle)->next(buf, len);
+}
+
+void loader_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"

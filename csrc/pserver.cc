@@ -1,0 +1,239 @@
+// Async sparse parameter server (reference analog: go/pserver — the Go
+// parameter server used by the sparse/CTR path — rebuilt in C++).
+//
+// Model: the server holds dense rows of embedding tables in host DRAM.
+// Trainers send sparse row updates (SGD applied server-side, Hogwild-style
+// per-row locking) and fetch rows on demand.  Transport is a trivial
+// length-prefixed binary protocol over TCP (one thread per connection —
+// trainer counts are small); this is the host-side sparse path, never TPU
+// compute.
+//
+// Wire protocol (little-endian):
+//   request  := op:u8 | table_len:u16 | table_bytes | payload
+//   op 0 (INIT):  rows:u32 | width:u32           -> status:u8
+//   op 1 (PUSH):  lr:f32 | n:u32 | (row_id:u32 | f32*width)*n -> status:u8
+//   op 2 (PULL):  n:u32 | (row_id:u32)*n         -> status:u8 | f32*width*n
+//   op 3 (SAVE):  path_len:u16 | path            -> status:u8
+//   op 4 (SHUTDOWN)                              -> status:u8
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Table {
+  uint32_t rows = 0, width = 0;
+  std::vector<float> data;
+  std::vector<std::mutex> row_locks;
+
+  Table() = default;
+  Table(uint32_t r, uint32_t w) : rows(r), width(w), data(size_t(r) * w, 0.f), row_locks(r) {}
+};
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex tables_mu;
+  std::unordered_map<std::string, Table> tables;
+
+  bool read_all(int fd, void* buf, size_t n) {
+    uint8_t* p = static_cast<uint8_t*>(buf);
+    while (n) {
+      ssize_t r = recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= size_t(r);
+    }
+    return true;
+  }
+
+  bool write_all(int fd, const void* buf, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(buf);
+    while (n) {
+      ssize_t r = send(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= size_t(r);
+    }
+    return true;
+  }
+
+  void handle(int fd) {
+    for (;;) {
+      uint8_t op;
+      if (!read_all(fd, &op, 1)) break;
+      uint16_t tlen;
+      if (!read_all(fd, &tlen, 2)) break;
+      std::string table(tlen, '\0');
+      if (tlen && !read_all(fd, &table[0], tlen)) break;
+
+      uint8_t ok = 1;
+      if (op == 0) {  // INIT
+        uint32_t rows, width;
+        if (!read_all(fd, &rows, 4) || !read_all(fd, &width, 4)) break;
+        {
+          std::lock_guard<std::mutex> lk(tables_mu);
+          if (!tables.count(table)) tables.emplace(table, Table(rows, width));
+        }
+        if (!write_all(fd, &ok, 1)) break;
+      } else if (op == 1) {  // PUSH (server-side SGD on rows)
+        float lr;
+        uint32_t n;
+        if (!read_all(fd, &lr, 4) || !read_all(fd, &n, 4)) break;
+        Table* t;
+        {
+          std::lock_guard<std::mutex> lk(tables_mu);
+          auto it = tables.find(table);
+          t = it == tables.end() ? nullptr : &it->second;
+        }
+        if (!t) { ok = 0; }
+        std::vector<float> grad(t ? t->width : 0);
+        for (uint32_t i = 0; i < n; ++i) {
+          uint32_t row;
+          if (!read_all(fd, &row, 4)) return;
+          if (!read_all(fd, grad.data(), grad.size() * 4)) return;
+          if (t && row < t->rows) {
+            std::lock_guard<std::mutex> lk(t->row_locks[row]);
+            float* dst = &t->data[size_t(row) * t->width];
+            for (uint32_t j = 0; j < t->width; ++j) dst[j] -= lr * grad[j];
+          }
+        }
+        if (!write_all(fd, &ok, 1)) break;
+      } else if (op == 2) {  // PULL
+        uint32_t n;
+        if (!read_all(fd, &n, 4)) break;
+        Table* t;
+        {
+          std::lock_guard<std::mutex> lk(tables_mu);
+          auto it = tables.find(table);
+          t = it == tables.end() ? nullptr : &it->second;
+        }
+        std::vector<uint32_t> ids(n);
+        if (n && !read_all(fd, ids.data(), n * 4)) break;
+        ok = t ? 1 : 0;
+        if (!write_all(fd, &ok, 1)) break;
+        if (t) {
+          std::vector<float> out(size_t(n) * t->width, 0.f);
+          for (uint32_t i = 0; i < n; ++i) {
+            if (ids[i] < t->rows) {
+              std::lock_guard<std::mutex> lk(t->row_locks[ids[i]]);
+              memcpy(&out[size_t(i) * t->width], &t->data[size_t(ids[i]) * t->width],
+                     t->width * 4);
+            }
+          }
+          if (!write_all(fd, out.data(), out.size() * 4)) break;
+        }
+      } else if (op == 3) {  // SAVE
+        uint16_t plen;
+        if (!read_all(fd, &plen, 2)) break;
+        std::string path(plen, '\0');
+        if (plen && !read_all(fd, &path[0], plen)) break;
+        std::lock_guard<std::mutex> lk(tables_mu);
+        auto it = tables.find(table);
+        if (it == tables.end()) {
+          ok = 0;
+        } else {
+          FILE* f = fopen(path.c_str(), "wb");
+          if (!f) {
+            ok = 0;
+          } else {
+            fwrite(&it->second.rows, 4, 1, f);
+            fwrite(&it->second.width, 4, 1, f);
+            fwrite(it->second.data.data(), 4, it->second.data.size(), f);
+            fclose(f);
+          }
+        }
+        if (!write_all(fd, &ok, 1)) break;
+      } else if (op == 4) {  // SHUTDOWN
+        write_all(fd, &ok, 1);
+        stop.store(true);
+        // poke the accept loop
+        int s = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in a{};
+        a.sin_family = AF_INET;
+        a.sin_port = htons(port);
+        a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
+        close(s);
+        break;
+      } else {
+        break;
+      }
+    }
+    close(fd);
+  }
+
+  bool serve(uint16_t want_port) {
+    listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(want_port);
+    if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    if (listen(listen_fd, 16) < 0) return false;
+    accept_thread = std::thread([this] {
+      while (!stop.load()) {
+        int fd = accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        if (stop.load()) {
+          close(fd);
+          break;
+        }
+        conns.emplace_back([this, fd] { handle(fd); });
+      }
+    });
+    return true;
+  }
+
+  ~Server() {
+    stop.store(true);
+    if (listen_fd >= 0) {
+      shutdown(listen_fd, SHUT_RDWR);
+      close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pserver_start(uint16_t port) {
+  Server* s = new Server();
+  if (!s->serve(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+uint16_t pserver_port(void* handle) { return static_cast<Server*>(handle)->port; }
+
+void pserver_stop(void* handle) { delete static_cast<Server*>(handle); }
+
+}  // extern "C"

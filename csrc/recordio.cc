@@ -1,0 +1,202 @@
+// Native chunked RecordIO (reference: paddle/fluid/recordio/{chunk,header,
+// writer,scanner}.cc — reimplemented for the paddle_tpu on-disk format, which
+// the pure-python paddle_tpu/recordio_io.py also speaks).
+//
+// Layout (little-endian):
+//   file  := chunk*
+//   chunk := magic:u32 (0x0CED10DB) | crc32:u32 | compress:u32 | num:u32 |
+//            total_len:u32 | payload
+//   payload (after optional deflate) := (rec_len:u32 | rec_bytes)*
+//
+// Exposed as a flat C API for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x0CED10DB;
+constexpr uint32_t kCompressNone = 0;
+constexpr uint32_t kCompressDeflate = 1;
+
+void put_u32(std::string* s, uint32_t v) {
+  char b[4] = {char(v & 0xff), char((v >> 8) & 0xff), char((v >> 16) & 0xff),
+               char((v >> 24) & 0xff)};
+  s->append(b, 4);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
+         uint32_t(p[3]) << 24;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::string body;
+  uint32_t num_records = 0;
+  uint32_t max_records;
+  uint32_t compress;
+
+  Writer(const char* path, uint32_t max_records, uint32_t compress)
+      : max_records(max_records), compress(compress) {
+    f = fopen(path, "wb");
+  }
+
+  bool flush() {
+    if (num_records == 0) return true;
+    std::string payload;
+    if (compress == kCompressDeflate) {
+      uLongf cap = compressBound(body.size());
+      payload.resize(cap);
+      if (compress2(reinterpret_cast<Bytef*>(&payload[0]), &cap,
+                    reinterpret_cast<const Bytef*>(body.data()), body.size(),
+                    Z_DEFAULT_COMPRESSION) != Z_OK)
+        return false;
+      payload.resize(cap);
+    } else {
+      payload = body;
+    }
+    uint32_t crc =
+        crc32(0, reinterpret_cast<const Bytef*>(payload.data()), payload.size());
+    std::string header;
+    put_u32(&header, kMagic);
+    put_u32(&header, crc);
+    put_u32(&header, compress);
+    put_u32(&header, num_records);
+    put_u32(&header, uint32_t(payload.size()));
+    if (fwrite(header.data(), 1, header.size(), f) != header.size()) return false;
+    if (fwrite(payload.data(), 1, payload.size(), f) != payload.size())
+      return false;
+    body.clear();
+    num_records = 0;
+    return true;
+  }
+
+  bool write(const void* buf, uint32_t len) {
+    put_u32(&body, len);
+    body.append(static_cast<const char*>(buf), len);
+    ++num_records;
+    if (num_records >= max_records) return flush();
+    return true;
+  }
+
+  ~Writer() {
+    if (f) {
+      flush();
+      fclose(f);
+    }
+  }
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> body;   // decompressed current chunk
+  size_t off = 0;              // cursor into body
+  uint32_t remaining = 0;      // records left in current chunk
+  std::vector<uint8_t> record; // last record (stable across next() calls)
+
+  explicit Reader(const char* path) { f = fopen(path, "rb"); }
+
+  bool load_chunk() {
+    uint8_t header[20];
+    if (fread(header, 1, 20, f) != 20) return false;
+    uint32_t magic = get_u32(header);
+    uint32_t crc = get_u32(header + 4);
+    uint32_t compress = get_u32(header + 8);
+    uint32_t num = get_u32(header + 12);
+    uint32_t total = get_u32(header + 16);
+    if (magic != kMagic) return false;
+    std::vector<uint8_t> payload(total);
+    if (fread(payload.data(), 1, total, f) != total) return false;
+    if (crc32(0, payload.data(), total) != crc) return false;
+    if (compress == kCompressDeflate) {
+      // deflate payloads don't carry the raw size; grow geometrically.
+      uLongf cap = payload.size() * 4 + 1024;
+      for (;;) {
+        body.resize(cap);
+        uLongf out = cap;
+        int rc = uncompress(body.data(), &out, payload.data(), payload.size());
+        if (rc == Z_OK) {
+          body.resize(out);
+          break;
+        }
+        if (rc != Z_BUF_ERROR) return false;
+        cap *= 2;
+      }
+    } else {
+      body = std::move(payload);
+    }
+    off = 0;
+    remaining = num;
+    return true;
+  }
+
+  // 1 = record produced, 0 = EOF, -1 = corrupt
+  int next(const uint8_t** buf, uint32_t* len) {
+    while (remaining == 0) {
+      if (!f || feof(f)) return 0;
+      if (!load_chunk()) return feof(f) ? 0 : -1;
+    }
+    if (off + 4 > body.size()) return -1;
+    uint32_t rlen = get_u32(body.data() + off);
+    off += 4;
+    if (off + rlen > body.size()) return -1;
+    record.assign(body.begin() + off, body.begin() + off + rlen);
+    off += rlen;
+    --remaining;
+    *buf = record.data();
+    *len = rlen;
+    return 1;
+  }
+
+  ~Reader() {
+    if (f) fclose(f);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, uint32_t max_records, uint32_t compress) {
+  Writer* w = new Writer(path, max_records ? max_records : 1000, compress);
+  if (!w->f) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int rio_writer_write(void* handle, const void* buf, uint32_t len) {
+  return static_cast<Writer*>(handle)->write(buf, len) ? 1 : 0;
+}
+
+int rio_writer_flush(void* handle) {
+  return static_cast<Writer*>(handle)->flush() ? 1 : 0;
+}
+
+void rio_writer_close(void* handle) { delete static_cast<Writer*>(handle); }
+
+void* rio_reader_open(const char* path) {
+  Reader* r = new Reader(path);
+  if (!r->f) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// returns 1 and sets *buf/*len on success; 0 on EOF; -1 on corruption.
+// *buf is valid until the next rio_reader_next/close on this handle.
+int rio_reader_next(void* handle, const uint8_t** buf, uint32_t* len) {
+  return static_cast<Reader*>(handle)->next(buf, len);
+}
+
+void rio_reader_close(void* handle) { delete static_cast<Reader*>(handle); }
+
+}  // extern "C"

@@ -1,0 +1,162 @@
+"""ctypes bindings for the native runtime library (csrc/ — recordio,
+threaded dataloader, async sparse pserver).
+
+Reference analogs: paddle/fluid/recordio/*, operators/reader/*, go/pserver.
+The library is optional: every consumer has a pure-python fallback, so
+``lib() is None`` is a supported state (e.g. before `make -C csrc`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB = None
+_TRIED = False
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
+
+
+def lib():
+    """Load (building on first use if possible) the native library, or None."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(
+                ["make", "-C", _CSRC], check=True, capture_output=True, timeout=120
+            )
+        except Exception:
+            return None
+    try:
+        L = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    L.rio_writer_open.restype = ctypes.c_void_p
+    L.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32]
+    L.rio_writer_write.restype = ctypes.c_int
+    L.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    L.rio_writer_flush.restype = ctypes.c_int
+    L.rio_writer_flush.argtypes = [ctypes.c_void_p]
+    L.rio_writer_close.argtypes = [ctypes.c_void_p]
+    L.rio_reader_open.restype = ctypes.c_void_p
+    L.rio_reader_open.argtypes = [ctypes.c_char_p]
+    L.rio_reader_next.restype = ctypes.c_int
+    L.rio_reader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32)]
+    L.rio_reader_close.argtypes = [ctypes.c_void_p]
+
+    L.loader_open.restype = ctypes.c_void_p
+    L.loader_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_uint32,
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    L.loader_next.restype = ctypes.c_int
+    L.loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32)]
+    L.loader_close.argtypes = [ctypes.c_void_p]
+
+    L.pserver_start.restype = ctypes.c_void_p
+    L.pserver_start.argtypes = [ctypes.c_uint16]
+    L.pserver_port.restype = ctypes.c_uint16
+    L.pserver_port.argtypes = [ctypes.c_void_p]
+    L.pserver_stop.argtypes = [ctypes.c_void_p]
+
+    _LIB = L
+    return _LIB
+
+
+class NativeRecordIOWriter:
+    def __init__(self, path, max_chunk_records=1000, compressor=1):
+        self._lib = lib()
+        self._h = self._lib.rio_writer_open(path.encode(), max_chunk_records, compressor)
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def write(self, record_bytes: bytes):
+        if not self._lib.rio_writer_write(self._h, record_bytes, len(record_bytes)):
+            raise IOError("recordio write failed")
+
+    def write_sample(self, sample):
+        import pickle
+
+        self.write(pickle.dumps(sample, protocol=4))
+
+    def flush(self):
+        self._lib.rio_writer_flush(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+class NativeRecordIOReader:
+    def __init__(self, path):
+        self._lib = lib()
+        self.path = path
+
+    def __iter__(self):
+        h = self._lib.rio_reader_open(self.path.encode())
+        if not h:
+            raise IOError("cannot open %s" % self.path)
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint32()
+        try:
+            while True:
+                rc = self._lib.rio_reader_next(h, ctypes.byref(buf), ctypes.byref(n))
+                if rc == 0:
+                    return
+                if rc < 0:
+                    raise IOError("corrupt recordio chunk in %s" % self.path)
+                yield ctypes.string_at(buf, n.value)
+        finally:
+            self._lib.rio_reader_close(h)
+
+
+class NativeLoader:
+    """Threaded shuffling prefetch over recordio files (csrc/dataloader.cc)."""
+
+    def __init__(self, files, num_threads=2, capacity=1024, shuffle_buf=0, seed=0, epochs=1):
+        self._lib = lib()
+        if isinstance(files, str):
+            files = [files]
+        self._h = self._lib.loader_open(
+            "\n".join(files).encode(), num_threads, capacity, shuffle_buf, seed, epochs
+        )
+        if not self._h:
+            raise IOError("loader_open failed for %r" % (files,))
+
+    def __iter__(self):
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint32()
+        while True:
+            rc = self._lib.loader_next(self._h, ctypes.byref(buf), ctypes.byref(n))
+            if rc == 0:
+                return
+            yield ctypes.string_at(buf, n.value)
+
+    def close(self):
+        if self._h:
+            self._lib.loader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -24,7 +24,7 @@ COMPRESS_NONE = 0
 COMPRESS_DEFLATE = 1
 
 
-class Writer:
+class PyWriter:
     def __init__(self, path, max_chunk_records=1000, compressor=COMPRESS_DEFLATE):
         self._f = open(path, "wb")
         self._records = []
@@ -65,7 +65,7 @@ class Writer:
         return False
 
 
-class Reader:
+class PyReader:
     def __init__(self, path):
         self.path = path
 
@@ -116,3 +116,44 @@ def read_batches(filename, shapes, dtypes, pass_num=1):
                 yield tuple(sample.values())
             else:
                 yield tuple(np.asarray(s) for s in sample)
+
+
+def _native_lib():
+    from . import native
+
+    return native.lib()
+
+
+class Writer:
+    """RecordIO writer: native C++ (csrc/recordio.cc) when built, else
+    pure-python — identical on-disk format either way."""
+
+    def __new__(cls, path, max_chunk_records=1000, compressor=COMPRESS_DEFLATE):
+        if _native_lib() is not None:
+            from .native import NativeRecordIOWriter
+
+            return NativeRecordIOWriter(path, max_chunk_records, compressor)
+        return PyWriter(path, max_chunk_records, compressor)
+
+
+class Reader:
+    """RecordIO reader: native C++ when built, else pure-python."""
+
+    def __new__(cls, path):
+        r = NativeReaderAdapter(path) if _native_lib() is not None else PyReader(path)
+        return r
+
+
+class NativeReaderAdapter:
+    def __init__(self, path):
+        from .native import NativeRecordIOReader
+
+        self._r = NativeRecordIOReader(path)
+        self.path = path
+
+    def __iter__(self):
+        return iter(self._r)
+
+    def iter_samples(self):
+        for rec in self:
+            yield pickle.loads(rec)

@@ -1,0 +1,88 @@
+"""RecordIO: native C++ <-> pure-python cross-compatibility, threaded
+loader, and converter roundtrip (mirrors reference recordio tests:
+chunk_test.cc, writer_scanner_test.cc, test_recordio_reader.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio_io
+from paddle_tpu.native import lib as native_lib
+
+
+def _samples(n=25):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(4).astype("float32"), int(i)) for i in range(n)]
+
+
+def test_python_roundtrip(tmp_path):
+    p = str(tmp_path / "a.recordio")
+    w = recordio_io.PyWriter(p, max_chunk_records=10)
+    for s in _samples():
+        w.write_sample(s)
+    w.close()
+    got = list(recordio_io.PyReader(p).iter_samples())
+    assert len(got) == 25
+    np.testing.assert_array_equal(got[7][0], _samples()[7][0])
+    assert got[7][1] == 7
+
+
+@pytest.mark.skipif(native_lib() is None, reason="native lib not built")
+def test_native_python_cross_compat(tmp_path):
+    # python-written file read by native reader
+    p1 = str(tmp_path / "py.recordio")
+    w = recordio_io.PyWriter(p1, max_chunk_records=7)
+    for s in _samples():
+        w.write_sample(s)
+    w.close()
+    from paddle_tpu.native import NativeRecordIOReader, NativeRecordIOWriter
+    import pickle
+
+    got = [pickle.loads(r) for r in NativeRecordIOReader(p1)]
+    assert len(got) == 25 and got[3][1] == 3
+
+    # native-written file read by python reader
+    p2 = str(tmp_path / "nat.recordio")
+    nw = NativeRecordIOWriter(p2, max_chunk_records=7)
+    for s in _samples():
+        nw.write(pickle.dumps(s, protocol=4))
+    nw.close()
+    got2 = list(recordio_io.PyReader(p2).iter_samples())
+    assert len(got2) == 25
+    np.testing.assert_array_equal(got2[11][0], _samples()[11][0])
+
+
+@pytest.mark.skipif(native_lib() is None, reason="native lib not built")
+def test_native_loader_prefetch_and_shuffle(tmp_path):
+    import pickle
+
+    paths = []
+    for f in range(3):
+        p = str(tmp_path / ("f%d.recordio" % f))
+        w = recordio_io.Writer(p, max_chunk_records=4)
+        for i in range(10):
+            w.write_sample(("file%d" % f, i))
+        w.close()
+        paths.append(p)
+
+    from paddle_tpu.native import NativeLoader
+
+    out = [pickle.loads(r) for r in NativeLoader(paths, num_threads=2, capacity=8)]
+    assert len(out) == 30
+    assert sorted(out) == sorted([("file%d" % f, i) for f in range(3) for i in range(10)])
+
+    sh = [pickle.loads(r) for r in NativeLoader(paths, num_threads=2, shuffle_buf=16, seed=3)]
+    assert sorted(sh) == sorted(out)
+    assert sh != out  # shuffled order differs (astronomically unlikely otherwise)
+
+
+def test_convert_reader_to_recordio(tmp_path):
+    p = str(tmp_path / "conv.recordio")
+
+    def reader():
+        for i in range(12):
+            yield (np.full((2,), i, "float32"), i)
+
+    n = recordio_io.convert_reader_to_recordio_file(p, reader)
+    assert n == 12
+    got = list(recordio_io.Reader(p).iter_samples())
+    assert len(got) == 12 and got[5][1] == 5
