@@ -31,6 +31,9 @@ from . import metrics
 from . import average
 from . import profiler
 from . import lod as lod_tensor_mod
+from . import dataset
+from . import reader
+from .reader import batch
 
 from .core import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace
 from .framework import (

@@ -1,0 +1,36 @@
+"""Dataset package (reference: python/paddle/dataset/__init__.py).
+
+Same module surface as the reference; data is deterministic synthetic (this
+environment is offline) with reference-faithful schemas — see common.py.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import mq2007  # noqa: F401
+
+__all__ = [
+    "common",
+    "mnist",
+    "cifar",
+    "uci_housing",
+    "imdb",
+    "imikolov",
+    "movielens",
+    "sentiment",
+    "conll05",
+    "flowers",
+    "voc2012",
+    "wmt14",
+    "wmt16",
+    "mq2007",
+]

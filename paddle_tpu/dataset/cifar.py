@@ -1,0 +1,44 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py).
+
+Synthetic: 3072-float32 vectors in [0, 1] (reference: pixels/255), class
+templates + noise; int64 labels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+
+def _reader_creator(split, num_classes, size):
+    def reader():
+        r_t = rng_for("cifar%d" % num_classes, "templates")
+        tpl = r_t.rand(num_classes, 3072).astype("float32")
+        r = rng_for("cifar%d" % num_classes, split)
+        for _ in range(size):
+            label = int(r.randint(0, num_classes))
+            img = np.clip(tpl[label] + 0.2 * r.randn(3072).astype("float32"), 0.0, 1.0)
+            yield img.astype("float32"), label
+
+    return reader
+
+
+def train10():
+    return _reader_creator("train", 10, TRAIN_SIZE)
+
+
+def test10():
+    return _reader_creator("test", 10, TEST_SIZE)
+
+
+def train100():
+    return _reader_creator("train", 100, TRAIN_SIZE)
+
+
+def test100():
+    return _reader_creator("test", 100, TEST_SIZE)

@@ -1,0 +1,124 @@
+"""Dataset plumbing (reference: python/paddle/dataset/common.py).
+
+This environment has zero network egress, so ``download`` cannot fetch the
+real corpora.  Every dataset module therefore generates *deterministic
+synthetic data* with the exact schema/shapes/dtypes of the reference
+readers (documented per module), cached under DATA_HOME.  The reader-creator
+API (``train()``/``test()`` returning a zero-arg generator factory) matches
+the reference so user code ports unchanged.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+import numpy as np
+
+__all__ = [
+    "DATA_HOME",
+    "download",
+    "md5file",
+    "split",
+    "cluster_files_reader",
+    "convert",
+    "rng_for",
+]
+
+DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Offline stand-in for the reference downloader: raises with a clear
+    message (datasets here are synthetic; nothing needs downloading)."""
+    raise RuntimeError(
+        "paddle_tpu.dataset runs offline: %r cannot be downloaded (no egress). "
+        "The %s dataset API serves deterministic synthetic data instead." % (url, module_name)
+    )
+
+
+def rng_for(name: str, split: str) -> np.random.RandomState:
+    """Deterministic per-(dataset, split) RNG so every process sees the same
+    synthetic corpus."""
+    seed = int.from_bytes(hashlib.md5(("%s/%s" % (name, split)).encode()).digest()[:4], "little")
+    return np.random.RandomState(seed)
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into multiple pickled files
+    (reference common.py:split)."""
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f, protocol=4))
+    indx_f = 0
+    batch = []
+    out_files = []
+
+    def dump(batch, indx_f):
+        path = suffix % indx_f
+        with open(path, "wb") as f:
+            dumper(batch, f)
+        out_files.append(path)
+
+    for sample in reader():
+        batch.append(sample)
+        if len(batch) == line_count:
+            dump(batch, indx_f)
+            batch, indx_f = [], indx_f + 1
+    if batch:
+        dump(batch, indx_f)
+    return out_files
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id, loader=None):
+    """Read this trainer's shard of pickled sample files
+    (reference common.py:cluster_files_reader)."""
+    import glob
+
+    loader = loader or pickle.load
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [f for i, f in enumerate(file_list) if i % trainer_count == trainer_id]
+        for path in my_files:
+            with open(path, "rb") as f:
+                for sample in loader(f):
+                    yield sample
+
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialize a reader to chunked recordio files
+    (reference common.py:convert → recordio)."""
+    from .. import recordio_io
+
+    must_mkdirs(output_path)
+    indx_f = 0
+    count = 0
+    w = None
+    paths = []
+    for sample in reader():
+        if w is None:
+            path = os.path.join(output_path, "%s-%05d" % (name_prefix, indx_f))
+            w = recordio_io.Writer(path)
+            paths.append(path)
+        w.write_sample(sample)
+        count += 1
+        if count == line_count:
+            w.close()
+            w, count, indx_f = None, 0, indx_f + 1
+    if w is not None:
+        w.close()
+    return paths

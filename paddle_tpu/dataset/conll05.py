@@ -1,0 +1,79 @@
+"""CoNLL-2005 SRL (reference: python/paddle/dataset/conll05.py).
+
+Synthetic sequence-labeling data with the reference's 8-slot sample schema:
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids(mark), label_ids)
+— each a python list of int64 per token; labels use an IOB tagset so
+chunk_eval / CRF training behave like on the real corpus.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["get_dict", "get_embedding", "test", "train"]
+
+WORD_VOCAB = 4000
+NUM_LABEL_TYPES = 5  # chunk types -> tags 0..(2*5); 10 = O
+LABEL_VOCAB = 2 * NUM_LABEL_TYPES + 1
+TRAIN_SIZE = 256
+TEST_SIZE = 64
+
+
+def get_dict():
+    word_dict = {"w%d" % i: i for i in range(WORD_VOCAB)}
+    verb_dict = {"v%d" % i: i for i in range(200)}
+    label_dict = {}
+    for t in range(NUM_LABEL_TYPES):
+        label_dict["B-A%d" % t] = 2 * t
+        label_dict["I-A%d" % t] = 2 * t + 1
+    label_dict["O"] = 2 * NUM_LABEL_TYPES
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    r = rng_for("conll05", "emb")
+    return r.randn(WORD_VOCAB, 32).astype("float32")
+
+
+def _reader(split, size):
+    def reader():
+        r = rng_for("conll05", split)
+        for _ in range(size):
+            L = int(r.randint(5, 25))
+            words = r.randint(0, WORD_VOCAB, size=L).astype("int64")
+            pred_pos = int(r.randint(0, L))
+            verb = np.full(L, int(words[pred_pos]) % 200, dtype="int64")
+            mark = np.zeros(L, dtype="int64")
+            mark[pred_pos] = 1
+            # IOB labels correlated with word parity so models can learn
+            labels = np.full(L, 2 * NUM_LABEL_TYPES, dtype="int64")
+            i = 0
+            while i < L:
+                if r.rand() < 0.3:
+                    t = int(words[i]) % NUM_LABEL_TYPES
+                    span = min(int(r.randint(1, 4)), L - i)
+                    labels[i] = 2 * t
+                    labels[i + 1 : i + span] = 2 * t + 1
+                    i += span
+                else:
+                    i += 1
+
+            def ctx(off):
+                idx = np.clip(np.arange(L) + off, 0, L - 1)
+                return list(words[idx])
+
+            yield (
+                list(words), ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                list(verb * 0 + mark), list(labels),
+            )
+
+    return reader
+
+
+def train():
+    return _reader("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader("test", TEST_SIZE)

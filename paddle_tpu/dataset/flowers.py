@@ -1,0 +1,41 @@
+"""Oxford 102 flowers (reference: python/paddle/dataset/flowers.py).
+
+Synthetic: (3*224*224 float32 image in [0,1], int64 label in [0,102)).
+``mapper``/``batched`` args accepted for API parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test", "valid"]
+
+NUM_CLASSES = 102
+SIZES = {"train": 256, "test": 64, "valid": 64}
+IMG_SHAPE = (3, 224, 224)
+
+
+def _reader(split, use_xmap=True):
+    def reader():
+        r = rng_for("flowers", split)
+        base = rng_for("flowers", "templates").rand(NUM_CLASSES, 3, 8, 8).astype("float32")
+        for _ in range(SIZES[split]):
+            label = int(r.randint(0, NUM_CLASSES))
+            small = np.clip(base[label] + 0.2 * r.randn(3, 8, 8), 0, 1).astype("float32")
+            img = np.kron(small, np.ones((28, 28), "float32"))  # 8*28=224
+            yield img.reshape(-1), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid")

@@ -1,0 +1,51 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py — aclImdb
+reviews tokenized against a frequency-sorted word dict).
+
+Synthetic: a Zipfian vocabulary; positive/negative docs are drawn from two
+shifted unigram distributions so sentiment models genuinely separate them.
+Sample schema matches the reference: ([int64 word ids], label 0/1).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["word_dict", "train", "test"]
+
+VOCAB = 5147  # same size the reference builds from aclImdb with cutoff 150
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+
+def word_dict():
+    """word -> id, frequency-ranked like the reference build_dict."""
+    return {"w%d" % i: i for i in range(VOCAB)}
+
+
+def _doc(r, vocab, label, length):
+    # class-dependent Zipf: positives skew to even ids, negatives to odd
+    ids = r.zipf(1.3, size=length)
+    ids = np.clip(ids, 1, vocab - 1)
+    ids = ids * 2 + (1 - label)
+    return list(np.clip(ids, 0, vocab - 1).astype("int64"))
+
+
+def _reader_creator(split, size):
+    def reader():
+        r = rng_for("imdb", split)
+        vocab = VOCAB
+        for _ in range(size):
+            label = int(r.randint(0, 2))
+            length = int(r.randint(8, 64))
+            yield _doc(r, vocab, label, length), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader_creator("train", TRAIN_SIZE)
+
+
+def test(word_idx=None):
+    return _reader_creator("test", TEST_SIZE)

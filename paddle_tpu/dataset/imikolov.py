@@ -1,0 +1,56 @@
+"""imikolov / PTB n-gram LM data (reference: python/paddle/dataset/imikolov.py).
+
+Synthetic: a Markov-ish token stream over a Zipf vocabulary; ``train(word_idx,
+n)`` yields n-tuples of int64 ids exactly like the reference NGRAM mode, and
+``data_type=SEQ`` yields whole sequences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["build_dict", "train", "test", "DataType"]
+
+VOCAB = 2073
+TRAIN_SENTENCES = 512
+TEST_SENTENCES = 128
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    return {"w%d" % i: i for i in range(VOCAB)}
+
+
+def _sentences(split, count):
+    r = rng_for("imikolov", split)
+    for _ in range(count):
+        length = int(r.randint(5, 20))
+        ids = np.clip(r.zipf(1.4, size=length), 1, VOCAB - 1).astype("int64")
+        yield list(ids)
+
+
+def _reader_creator(split, count, word_idx, n, data_type):
+    def reader():
+        for sent in _sentences(split, count):
+            if data_type == DataType.NGRAM:
+                if len(sent) >= n:
+                    sent_a = [0] * (n - 1) + sent  # pad with <s>=0 like the reference
+                    for i in range(n - 1, len(sent_a)):
+                        yield tuple(sent_a[i - n + 1 : i + 1])
+            else:
+                yield (sent,)
+
+    return reader
+
+
+def train(word_idx=None, n=5, data_type=DataType.NGRAM):
+    return _reader_creator("train", TRAIN_SENTENCES, word_idx, n, data_type)
+
+
+def test(word_idx=None, n=5, data_type=DataType.NGRAM):
+    return _reader_creator("test", TEST_SENTENCES, word_idx, n, data_type)

@@ -1,0 +1,41 @@
+"""MNIST (reference: python/paddle/dataset/mnist.py — 60k/10k ubyte files).
+
+Synthetic: each sample is a 784-float32 vector in [-1, 1] (the reference
+normalizes pixels to that range) drawn from a per-class template + noise,
+so classifiers genuinely learn; labels are int64 in [0, 10).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test"]
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def _templates():
+    r = rng_for("mnist", "templates")
+    return r.randn(10, 784).astype("float32")
+
+
+def _reader_creator(split, size):
+    def reader():
+        tpl = _templates()
+        r = rng_for("mnist", split)
+        for _ in range(size):
+            label = int(r.randint(0, 10))
+            img = np.tanh(tpl[label] + 0.5 * r.randn(784).astype("float32"))
+            yield img.astype("float32"), label
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader_creator("test", TEST_SIZE)

@@ -1,0 +1,102 @@
+"""MovieLens-1M (reference: python/paddle/dataset/movielens.py).
+
+Synthetic users/movies with the reference's feature schema:
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+rating) — all int64 lists/scalars + float rating in [1, 5].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = [
+    "train", "test", "get_movie_title_dict", "max_movie_id", "max_user_id",
+    "max_job_id", "age_table", "movie_categories", "user_info", "movie_info",
+]
+
+NUM_USERS = 200
+NUM_MOVIES = 300
+NUM_JOBS = 21
+CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+TITLE_VOCAB = 512
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def max_user_id():
+    return NUM_USERS
+
+
+def max_movie_id():
+    return NUM_MOVIES
+
+
+def max_job_id():
+    return NUM_JOBS - 1
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {"t%d" % i: i for i in range(TITLE_VOCAB)}
+
+
+def _movies():
+    r = rng_for("movielens", "movies")
+    movies = {}
+    for mid in range(1, NUM_MOVIES + 1):
+        ncat = int(r.randint(1, 4))
+        cats = sorted(r.choice(len(CATEGORIES), size=ncat, replace=False).tolist())
+        title = r.randint(0, TITLE_VOCAB, size=int(r.randint(1, 6))).tolist()
+        movies[mid] = (cats, title)
+    return movies
+
+
+def _users():
+    r = rng_for("movielens", "users")
+    users = {}
+    for uid in range(1, NUM_USERS + 1):
+        users[uid] = (int(r.randint(0, 2)), int(r.randint(0, len(age_table))), int(r.randint(0, NUM_JOBS)))
+    return users
+
+
+def _reader_creator(split, size):
+    def reader():
+        users, movies = _users(), _movies()
+        r = rng_for("movielens", split)
+        for _ in range(size):
+            uid = int(r.randint(1, NUM_USERS + 1))
+            mid = int(r.randint(1, NUM_MOVIES + 1))
+            gender, age, job = users[uid]
+            cats, title = movies[mid]
+            # preference structure so factorization models can learn
+            score = 3.0 + 0.7 * np.cos(uid * 0.37 + mid * 0.11) + 0.5 * r.randn()
+            rating = float(np.clip(np.round(score), 1, 5))
+            yield [uid], [gender], [age], [job], [mid], cats, title, [rating]
+
+    return reader
+
+
+def user_info():
+    return _users()
+
+
+def movie_info():
+    return _movies()
+
+
+def train():
+    return _reader_creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader_creator("test", TEST_SIZE)

@@ -1,0 +1,62 @@
+"""MQ2007 learning-to-rank (reference: python/paddle/dataset/mq2007.py).
+
+Synthetic LETOR-style data: queries with candidate docs, 46-dim features,
+relevance in {0,1,2}; ``format`` selects pointwise/pairwise/listwise
+exactly like the reference reader.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test"]
+
+FEATURE_DIM = 46
+TRAIN_QUERIES = 64
+TEST_QUERIES = 16
+
+
+def _w():
+    return rng_for("mq2007", "w").randn(FEATURE_DIM).astype("float32")
+
+
+def _queries(split, count):
+    r = rng_for("mq2007", split)
+    w = _w()
+    for qid in range(count):
+        n_docs = int(r.randint(5, 15))
+        feats = r.randn(n_docs, FEATURE_DIM).astype("float32")
+        scores = feats @ w + 0.3 * r.randn(n_docs)
+        rel = np.digitize(scores, np.percentile(scores, [50, 85])).astype("int64")
+        yield rel, feats
+
+
+def _reader(split, count, format, **kwargs):
+    def pointwise():
+        for rel, feats in _queries(split, count):
+            for i in range(len(rel)):
+                yield int(rel[i]), feats[i]
+
+    def pairwise():
+        for rel, feats in _queries(split, count):
+            for i, j in itertools.combinations(range(len(rel)), 2):
+                if rel[i] != rel[j]:
+                    hi, lo = (i, j) if rel[i] > rel[j] else (j, i)
+                    yield 1, feats[hi], feats[lo]
+
+    def listwise():
+        for rel, feats in _queries(split, count):
+            yield list(rel), feats
+
+    return {"pointwise": pointwise, "pairwise": pairwise, "listwise": listwise}[format]
+
+
+def train(format="pairwise", **kwargs):
+    return _reader("train", TRAIN_QUERIES, format, **kwargs)
+
+
+def test(format="pairwise", **kwargs):
+    return _reader("test", TEST_QUERIES, format, **kwargs)

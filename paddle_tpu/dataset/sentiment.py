@@ -1,0 +1,39 @@
+"""Movie-review sentiment (reference: python/paddle/dataset/sentiment.py,
+NLTK movie_reviews corpus).  Synthetic, same scheme as imdb but smaller
+vocab; samples are ([int64 ids], label 0/1).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["get_word_dict", "train", "test"]
+
+VOCAB = 1000
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+
+
+def get_word_dict():
+    return [("w%d" % i, i) for i in range(VOCAB)]
+
+
+def _reader(split, size):
+    def reader():
+        r = rng_for("sentiment", split)
+        for _ in range(size):
+            label = int(r.randint(0, 2))
+            length = int(r.randint(5, 40))
+            ids = np.clip(r.zipf(1.3, size=length), 1, VOCAB // 2 - 1) * 2 + (1 - label)
+            yield list(np.clip(ids, 0, VOCAB - 1).astype("int64")), label
+
+    return reader
+
+
+def train():
+    return _reader("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader("test", TEST_SIZE)

@@ -1,0 +1,45 @@
+"""UCI housing regression (reference: python/paddle/dataset/uci_housing.py —
+506 samples, 13 features, normalized).
+
+Synthetic: x ~ N(0,1)^13, y = x·w + noise with a fixed hidden w, so linear
+regression converges exactly like on the real data.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+    "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+
+def _w():
+    return rng_for("uci_housing", "w").randn(13).astype("float32")
+
+
+def _reader_creator(split, size):
+    def reader():
+        w = _w()
+        r = rng_for("uci_housing", split)
+        for _ in range(size):
+            x = r.randn(13).astype("float32")
+            y = np.array([x @ w + 0.1 * r.randn()], dtype="float32")
+            yield x, y
+
+    return reader
+
+
+def train():
+    return _reader_creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader_creator("test", TEST_SIZE)

@@ -1,0 +1,89 @@
+"""PASCAL VOC2012 (reference: python/paddle/dataset/voc2012.py —
+segmentation pairs; the SSD pipeline also consumes VOC-style detection
+boxes, so this module serves both):
+
+- ``train()/test()/val()``: (image 3xHxW float32 [0,1], label HxW int32
+  segmentation map) like the reference.
+- ``train_detection()/test_detection()``: (image 3x300x300, gt boxes
+  [N,4] float32 normalized xmin/ymin/xmax/ymax, gt labels [N] int64,
+  difficult [N] int64) for the SSD model.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test", "val", "train_detection", "test_detection"]
+
+NUM_CLASSES = 21  # 20 + background
+H = W = 96
+SIZES = {"train": 64, "test": 16, "val": 16}
+DET_SIZE = {"train": 128, "test": 32}
+
+
+def _seg_reader(split):
+    def reader():
+        r = rng_for("voc2012", split)
+        for _ in range(SIZES[split]):
+            img = r.rand(3, H, W).astype("float32")
+            label = np.zeros((H, W), "int32")
+            for _ in range(int(r.randint(1, 4))):
+                c = int(r.randint(1, NUM_CLASSES))
+                x0, y0 = r.randint(0, W - 16), r.randint(0, H - 16)
+                w, h = r.randint(8, 32), r.randint(8, 32)
+                label[y0 : y0 + h, x0 : x0 + w] = c
+                img[:, y0 : y0 + h, x0 : x0 + w] += 0.1 * c / NUM_CLASSES
+            yield np.clip(img, 0, 1), label
+
+    return reader
+
+
+def train():
+    return _seg_reader("train")
+
+
+def test():
+    return _seg_reader("test")
+
+
+def val():
+    return _seg_reader("val")
+
+
+def _det_reader(split, size=300):
+    def reader():
+        r = rng_for("voc2012_det", split)
+        for _ in range(DET_SIZE[split]):
+            img = r.rand(3, size, size).astype("float32")
+            n = int(r.randint(1, 6))
+            boxes = []
+            labels = []
+            for _ in range(n):
+                cx, cy = r.rand(), r.rand()
+                w, h = 0.05 + 0.4 * r.rand(), 0.05 + 0.4 * r.rand()
+                xmin, ymin = max(cx - w / 2, 0.0), max(cy - h / 2, 0.0)
+                xmax, ymax = min(cx + w / 2, 1.0), min(cy + h / 2, 1.0)
+                c = int(r.randint(1, NUM_CLASSES))
+                boxes.append([xmin, ymin, xmax, ymax])
+                labels.append(c)
+                # paint the object so detectors can learn
+                x0, y0 = int(xmin * size), int(ymin * size)
+                x1, y1 = max(int(xmax * size), x0 + 1), max(int(ymax * size), y0 + 1)
+                img[:, y0:y1, x0:x1] = np.array([[[c / NUM_CLASSES]], [[0.5]], [[1 - c / NUM_CLASSES]]])
+            yield (
+                np.clip(img, 0, 1),
+                np.asarray(boxes, "float32"),
+                np.asarray(labels, "int64"),
+                np.zeros(n, "int64"),
+            )
+
+    return reader
+
+
+def train_detection():
+    return _det_reader("train")
+
+
+def test_detection():
+    return _det_reader("test")

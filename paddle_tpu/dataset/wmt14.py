@@ -1,0 +1,48 @@
+"""WMT14 en-fr (reference: python/paddle/dataset/wmt14.py).
+
+Synthetic parallel corpus: target = deterministic per-token mapping of
+source (+ length jitter), so seq2seq models can genuinely learn the
+"translation".  Sample schema matches the reference:
+(src_ids, trg_ids, trg_next_ids) with <s>=0, <e>=1, <unk>=2.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test", "get_dict"]
+
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+
+
+def get_dict(dict_size, reverse=False):
+    src = {"w%d" % i: i for i in range(dict_size)}
+    trg = {"t%d" % i: i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _reader(split, size, dict_size):
+    def reader():
+        r = rng_for("wmt14", split)
+        for _ in range(size):
+            L = int(r.randint(4, 16))
+            src = np.clip(r.zipf(1.2, size=L), 3, dict_size - 1).astype("int64")
+            trg = (src * 7 + 3) % (dict_size - 3) + 3  # bijective-ish token map
+            trg_in = np.concatenate([[0], trg])
+            trg_next = np.concatenate([trg, [1]])
+            yield list(src), list(trg_in), list(trg_next)
+
+    return reader
+
+
+def train(dict_size):
+    return _reader("train", TRAIN_SIZE, dict_size)
+
+
+def test(dict_size):
+    return _reader("test", TEST_SIZE, dict_size)

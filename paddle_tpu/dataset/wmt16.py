@@ -1,0 +1,44 @@
+"""WMT16 en-de (reference: python/paddle/dataset/wmt16.py).
+
+Synthetic parallel corpus, reference schema: (src_ids, trg_in, trg_next)
+with separate src/trg dict sizes and <s>/<e>/<unk> = 0/1/2.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"%s%d" % (lang, i): i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def _reader(split, size, src_dict_size, trg_dict_size):
+    def reader():
+        r = rng_for("wmt16", split)
+        for _ in range(size):
+            L = int(r.randint(4, 16))
+            src = np.clip(r.zipf(1.2, size=L), 3, src_dict_size - 1).astype("int64")
+            trg = (src * 5 + 11) % (trg_dict_size - 3) + 3
+            yield list(src), list(np.concatenate([[0], trg])), list(np.concatenate([trg, [1]]))
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("train", TRAIN_SIZE, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("test", TEST_SIZE, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("validation", TEST_SIZE, src_dict_size, trg_dict_size)

@@ -1,0 +1,99 @@
+"""Dataset surface tests: every module yields reference-schema samples,
+deterministically (mirrors reference test_mnist/test_cifar/... strategy)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset
+
+
+def test_mnist_schema_and_determinism():
+    s1 = list(dataset.mnist.train()())[:5]
+    s2 = list(dataset.mnist.train()())[:5]
+    for (x1, y1), (x2, y2) in zip(s1, s2):
+        np.testing.assert_array_equal(x1, x2)
+        assert y1 == y2
+    x, y = s1[0]
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert x.min() >= -1 and x.max() <= 1 and 0 <= y < 10
+    assert len(list(dataset.mnist.test()())) == dataset.mnist.TEST_SIZE
+
+
+def test_cifar_schema():
+    x, y = next(dataset.cifar.train10()())
+    assert x.shape == (3072,) and 0 <= y < 10
+    x, y = next(dataset.cifar.train100()())
+    assert 0 <= y < 100
+
+
+def test_uci_housing_learnable():
+    xs, ys = zip(*list(dataset.uci_housing.train()()))
+    X, Y = np.stack(xs), np.stack(ys).ravel()
+    w, *_ = np.linalg.lstsq(X, Y, rcond=None)
+    resid = Y - X @ w
+    assert resid.std() < 0.2  # linear structure present
+
+
+def test_imdb_imikolov_sentiment():
+    doc, label = next(dataset.imdb.train(dataset.imdb.word_dict())())
+    assert isinstance(doc, list) and label in (0, 1)
+    assert max(doc) < dataset.imdb.VOCAB
+    gram = next(dataset.imikolov.train(None, 5)())
+    assert len(gram) == 5
+    doc, label = next(dataset.sentiment.train()())
+    assert isinstance(doc, list) and label in (0, 1)
+
+
+def test_movielens_schema():
+    s = next(dataset.movielens.train()())
+    uid, gender, age, job, mid, cats, title, rating = s
+    assert 1 <= uid[0] <= dataset.movielens.max_user_id()
+    assert 1 <= mid[0] <= dataset.movielens.max_movie_id()
+    assert 1.0 <= rating[0] <= 5.0
+    assert all(0 <= c < len(dataset.movielens.CATEGORIES) for c in cats)
+
+
+def test_conll05_schema():
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    s = next(dataset.conll05.train()())
+    assert len(s) == 8
+    L = len(s[0])
+    assert all(len(col) == L for col in s)
+    assert max(s[7]) < len(label_dict)
+
+
+def test_flowers_voc():
+    img, label = next(dataset.flowers.train()())
+    assert img.shape == (3 * 224 * 224,) and 0 <= label < 102
+    img, seg = next(dataset.voc2012.train()())
+    assert img.shape[0] == 3 and seg.shape == img.shape[1:]
+    img, boxes, labels, difficult = next(dataset.voc2012.train_detection()())
+    assert img.shape == (3, 300, 300)
+    assert boxes.shape[1] == 4 and len(labels) == len(boxes)
+    assert (boxes[:, 2] >= boxes[:, 0]).all() and boxes.max() <= 1.0
+
+
+def test_wmt_schema():
+    src, trg_in, trg_next = next(dataset.wmt14.train(1000)())
+    assert trg_in[0] == 0 and trg_next[-1] == 1
+    assert len(trg_in) == len(trg_next)
+    src, trg_in, trg_next = next(dataset.wmt16.train(1000, 800)())
+    assert max(trg_in) < 800
+
+
+def test_mq2007_formats():
+    rel, feats = next(dataset.mq2007.train(format="listwise")())
+    assert feats.shape[1] == 46 and len(rel) == feats.shape[0]
+    y, hi, lo = next(dataset.mq2007.train(format="pairwise")())
+    assert y == 1 and hi.shape == (46,)
+
+
+def test_batch_and_convert(tmp_path):
+    batched = fluid.batch(dataset.uci_housing.test(), batch_size=32)
+    b = next(batched())
+    assert len(b) == 32
+    paths = dataset.common.convert(str(tmp_path), dataset.cifar.test10(), 100, "cifar")
+    assert len(paths) == 3  # 256 samples / 100 per file
+    from paddle_tpu import recordio_io
+
+    n = sum(1 for _ in recordio_io.Reader(paths[0]).iter_samples())
+    assert n == 100
