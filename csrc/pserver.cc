@@ -48,6 +48,8 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::vector<std::thread> conns;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;
   std::mutex tables_mu;
   std::unordered_map<std::string, Table> tables;
 
@@ -201,6 +203,10 @@ struct Server {
           close(fd);
           break;
         }
+        {
+          std::lock_guard<std::mutex> lk(conn_mu);
+          conn_fds.push_back(fd);
+        }
         conns.emplace_back([this, fd] { handle(fd); });
       }
     });
@@ -212,6 +218,11 @@ struct Server {
     if (listen_fd >= 0) {
       shutdown(listen_fd, SHUT_RDWR);
       close(listen_fd);
+    }
+    {
+      // unblock connection threads parked in recv()
+      std::lock_guard<std::mutex> lk(conn_mu);
+      for (int fd : conn_fds) shutdown(fd, SHUT_RDWR);
     }
     if (accept_thread.joinable()) accept_thread.join();
     for (auto& t : conns)

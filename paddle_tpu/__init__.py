@@ -32,6 +32,8 @@ from . import average
 from . import profiler
 from . import lod as lod_tensor_mod
 from . import dataset
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, InferenceTranspiler, memory_optimize, release_memory
 from . import reader
 from .reader import batch
 

@@ -420,6 +420,20 @@ class Executor:
         program = program or default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
+
+        # distributed programs: listen_and_serv blocks serving; send/recv
+        # trainer programs run compute as one XLA step + host-side RPC round
+        op_types = {op.type for op in program.global_block().ops}
+        if "listen_and_serv" in op_types:
+            from .transpiler import pserver_runtime
+
+            return pserver_runtime.serve(self, program, scope)
+        if "send" in op_types or "recv" in op_types:
+            from .transpiler import pserver_runtime
+
+            clients = self._pserver_clients(program)
+            return pserver_runtime.run_trainer_step(self, program, feed, fetch_list, scope, clients)
+
         fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
 
         feed_arrays = self._prepare_feed(program, feed)
@@ -447,6 +461,18 @@ class Executor:
         return list(fetches)
 
     # -- internals -----------------------------------------------------------
+    def _pserver_clients(self, program):
+        from .transpiler.pserver_runtime import PSClient
+
+        if not hasattr(self, "_ps_clients"):
+            self._ps_clients = {}
+        for op in program.global_block().ops:
+            if op.type in ("send", "recv"):
+                for ep in op.attrs.get("endpoints", []):
+                    if ep not in self._ps_clients:
+                        self._ps_clients[ep] = PSClient(ep)
+        return self._ps_clients
+
     def _prepare_feed(self, program, feed):
         out = {}
         blk = program.global_block()
@@ -550,4 +576,10 @@ class Executor:
         return runner
 
     def close(self):
+        """Drop compiled executables and notify pservers this trainer is done
+        (reference: Executor.close sends the barrier/exit RPC)."""
         self._cache.clear()
+        for c in getattr(self, "_ps_clients", {}).values():
+            c.shutdown_server()
+            c.close()
+        self._ps_clients = {}

@@ -13,3 +13,4 @@ from . import machine_translation  # noqa: F401
 from . import transformer  # noqa: F401
 from . import ocr_crnn_ctc  # noqa: F401
 from . import word2vec  # noqa: F401
+from . import deepfm  # noqa: F401

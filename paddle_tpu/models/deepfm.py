@@ -1,0 +1,87 @@
+"""DeepFM CTR model (reference: the fluid CTR/DeepFM benchmark —
+models/fluid/PaddleRec deepfm; the sparse path go/pserver serves the
+embedding shards).
+
+y = sigmoid( w0 + Σ_i w1[f_i]            (first order)
+           + ΣΣ_{i<j} <v[f_i], v[f_j]>   (FM second order, computed as
+                                          0.5*(  (Σv)² - Σv²  ) — one matmul)
+           + MLP(concat v[f_i]) )        (deep part)
+
+TPU-native: field embeddings are gathers from one table; the FM pairwise
+term uses the sum-of-squares identity (no O(F²) loop); the MLP is
+MXU-shaped.  The embedding table is the pserver-shardable sparse parameter
+(csrc/pserver.cc serves its rows in the distributed CTR setup).
+"""
+from __future__ import annotations
+
+from .. import layers, optimizer as optim
+
+NUM_FIELDS = 26
+SPARSE_FEATURE_DIM = 1000  # ids per field (hashed), reference uses 1e6-1e7
+EMBEDDING_DIM = 8
+
+
+def deepfm_net(feat_ids, embedding_size=EMBEDDING_DIM, sparse_feature_dim=SPARSE_FEATURE_DIM,
+               num_fields=NUM_FIELDS, hidden_sizes=(64, 32), is_sparse=True):
+    """``feat_ids``: int64 [batch, num_fields] — one id per field."""
+    import paddle_tpu as fluid
+
+    # first-order weights: [vocab, 1] table
+    w1 = layers.embedding(
+        input=feat_ids,
+        size=[sparse_feature_dim, 1],
+        is_sparse=is_sparse,
+        param_attr=fluid.ParamAttr(name="deepfm_w1"),
+    )  # [B, F, 1]
+    first_order = layers.reduce_sum(w1, dim=1)  # [B, 1]
+
+    # shared factor table: [vocab, k]
+    v = layers.embedding(
+        input=feat_ids,
+        size=[sparse_feature_dim, embedding_size],
+        is_sparse=is_sparse,
+        param_attr=fluid.ParamAttr(name="deepfm_v"),
+    )  # [B, F, k]
+    sum_v = layers.reduce_sum(v, dim=1)  # [B, k]
+    sum_v_sq = layers.elementwise_mul(sum_v, sum_v)
+    v_sq = layers.elementwise_mul(v, v)
+    sq_sum_v = layers.reduce_sum(v_sq, dim=1)  # [B, k]
+    second_order = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_v_sq, sq_sum_v), dim=1, keep_dim=True),
+        scale=0.5,
+    )  # [B, 1]
+
+    deep = layers.reshape(v, shape=[-1, num_fields * embedding_size])
+    for h in hidden_sizes:
+        deep = layers.fc(input=deep, size=h, act="relu")
+    deep_out = layers.fc(input=deep, size=1)
+
+    logit = layers.elementwise_add(layers.elementwise_add(first_order, second_order), deep_out)
+    return logit
+
+
+def get_model(batch_size=256, embedding_size=EMBEDDING_DIM, sparse_feature_dim=SPARSE_FEATURE_DIM,
+              num_fields=NUM_FIELDS, lr=1e-3, is_sparse=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat_ids = layers.data(name="feat_ids", shape=[num_fields], dtype="int64")
+        label = layers.data(name="label", shape=[1], dtype="float32")
+        logit = deepfm_net(feat_ids, embedding_size, sparse_feature_dim, num_fields, is_sparse=is_sparse)
+        loss = layers.sigmoid_cross_entropy_with_logits(x=logit, label=label)
+        avg_cost = layers.mean(loss)
+        predict = layers.sigmoid(logit)
+        auc, _auc_states = layers.auc(input=predict, label=layers.cast(x=label, dtype="int64"))
+        inference_program = main.clone(for_test=True)
+        optim.AdamOptimizer(learning_rate=lr).minimize(avg_cost)
+    return {
+        "main": main,
+        "startup": startup,
+        "test": inference_program,
+        "feeds": ["feat_ids", "label"],
+        "loss": avg_cost,
+        "auc": auc,
+        "predict": predict,
+    }

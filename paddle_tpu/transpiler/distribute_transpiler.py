@@ -1,0 +1,176 @@
+"""DistributeTranspiler (reference:
+python/paddle/fluid/transpiler/distribute_transpiler.py).
+
+Splits a single-node training Program into:
+- a *trainer* program: forward + backward, optimizer ops replaced by one
+  ``send`` op (grads → pserver shards) and one ``recv`` op (fresh params ←
+  pservers).  The Executor runs the compute as one XLA step and performs
+  send/recv as host-side RPC after the step (pserver_runtime.py) — the
+  TPU-native analog of the reference's send/recv operators around NCCL-less
+  CPU transport.
+- per-endpoint *pserver* programs: a single ``listen_and_serv`` op whose
+  sub-block holds the optimizer ops for the params sharded onto that
+  endpoint.  ``Executor.run(pserver_program)`` enters the serve loop exactly
+  like the reference.
+
+Sharding is whole-parameter (RoundRobin/HashName over params); the
+reference's slice-level splitting of huge params is NOT replicated — on TPU
+large params live sharded on the device mesh via ParallelExecutor instead,
+and the pserver path is for the sparse/CTR workload.
+"""
+from __future__ import annotations
+
+from ..framework import OpRole, Program, Variable
+from .ps_dispatcher import RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    slice_var_up = False  # whole-param sharding only (see module docstring)
+    split_method = RoundRobin
+    min_block_size = 8192
+
+
+def _optimize_ops(program):
+    return [op for op in program.global_block().ops if op.attrs.get("op_role") == OpRole.Optimize]
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def transpile(
+        self,
+        trainer_id,
+        program=None,
+        pservers="127.0.0.1:6174",
+        trainers=1,
+        sync_mode=True,
+        startup_program=None,
+    ):
+        from ..framework import default_main_program, default_startup_program
+
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.pserver_endpoints = [ep.strip() for ep in pservers.split(",") if ep.strip()]
+
+        opt_ops = _optimize_ops(self.origin_program)
+        # (param, grad) names handled by each optimize op
+        self.param_opt_ops = []  # [(param_name, grad_name, op)]
+        for op in opt_ops:
+            if "Param" in op.inputs and "Grad" in op.inputs:
+                self.param_opt_ops.append((op.inputs["Param"][0], op.inputs["Grad"][0], op))
+
+        dispatcher = self.config.split_method(self.pserver_endpoints)
+        params = [self.origin_program.global_block().vars[p] for p, _, _ in self.param_opt_ops]
+        eps = dispatcher.dispatch(params)
+        self.param_ep = {p.name: ep for p, ep in zip(params, eps)}
+
+    # -- trainer side --------------------------------------------------------
+    def get_trainer_program(self):
+        p = self.origin_program.clone()
+        blk = p.global_block()
+        # drop every optimize-role op (incl. lr schedulers that feed them)
+        blk.ops = [op for op in blk.ops if op.attrs.get("op_role") != OpRole.Optimize]
+        grad_ep = {}
+        param_ep = {}
+        for param, grad, _op in self.param_opt_ops:
+            ep = self.param_ep[param]
+            grad_ep[grad] = ep
+            param_ep[param] = ep
+        blk.append_op(
+            type="send",
+            inputs={"X": sorted(grad_ep)},
+            outputs={},
+            attrs={
+                "epmap": [grad_ep[g] for g in sorted(grad_ep)],
+                "endpoints": self.pserver_endpoints,
+                "sync_mode": self.sync_mode,
+                "op_role": OpRole.RPC,
+            },
+        )
+        blk.append_op(
+            type="recv",
+            inputs={},
+            outputs={"Out": sorted(param_ep)},
+            attrs={
+                "epmap": [param_ep[pn] for pn in sorted(param_ep)],
+                "endpoints": self.pserver_endpoints,
+                "op_role": OpRole.RPC,
+            },
+        )
+        p._bump()
+        return p
+
+    # -- pserver side --------------------------------------------------------
+    def get_pserver_program(self, endpoint):
+        mine = [(p, g, op) for p, g, op in self.param_opt_ops if self.param_ep[p] == endpoint]
+        prog = Program()
+        blk = prog.global_block()
+        src_blk = self.origin_program.global_block()
+
+        opt_block = prog.create_block()
+        needed_vars = set()
+        grad_names = []
+        param_names = []
+        for pname, gname, op in mine:
+            param_names.append(pname)
+            grad_names.append(gname)
+            new_op = opt_block.append_op(
+                type=op.type, inputs=dict(op.inputs), outputs=dict(op.outputs), attrs=dict(op.attrs)
+            )
+            for names in list(op.inputs.values()) + list(op.outputs.values()):
+                needed_vars.update(names)
+        for n in sorted(needed_vars):
+            if n in src_blk.vars:
+                v = src_blk.vars[n]
+                blk.create_var(
+                    name=v.name,
+                    shape=v.shape,
+                    dtype=v.dtype,
+                    persistable=(n not in grad_names) and v.persistable,
+                )
+        prog.current_block_idx = 0
+        blk.append_op(
+            type="listen_and_serv",
+            inputs={},
+            outputs={},
+            attrs={
+                "endpoint": endpoint,
+                "Fanin": self.trainers,
+                "sync_mode": self.sync_mode,
+                "optimize_block": opt_block.idx,
+                "sub_block": opt_block.idx,
+                "grad_names": sorted(grad_names),
+                "param_names": sorted(param_names),
+                "op_role": OpRole.RPC,
+            },
+        )
+        prog._bump()
+        return prog
+
+    def get_startup_program(self, endpoint, pserver_program, startup_program=None):
+        """Init program for one pserver: the original startup ops whose outputs
+        are persistable on that pserver (params + optimizer accumulators + lr)."""
+        startup = startup_program or self.startup_program
+        persistables = {
+            v.name for v in pserver_program.list_vars() if v.persistable
+        }
+        p = Program()
+        blk = p.global_block()
+        src = startup.global_block()
+        for op in src.ops:
+            outs = [n for names in op.outputs.values() for n in names]
+            if any(o in persistables for o in outs):
+                for names in list(op.inputs.values()) + [outs]:
+                    for n in names:
+                        if n in src.vars and not blk.has_var(n):
+                            v = src.vars[n]
+                            blk.create_var(name=v.name, shape=v.shape, dtype=v.dtype, persistable=True)
+                blk.append_op(type=op.type, inputs=dict(op.inputs), outputs=dict(op.outputs), attrs=dict(op.attrs))
+        p._bump()
+        return p

@@ -1,0 +1,26 @@
+"""memory_optimize / release_memory (reference:
+python/paddle/fluid/transpiler/memory_optimization_transpiler.py).
+
+The reference rewrites the program to reuse variable buffers (liveness-based
+in-place sharing).  Under XLA this pass is intentionally a no-op: the whole
+block compiles to one executable whose buffer assignment already performs
+liveness-based reuse, and the Executor donates the parameter/optimizer-state
+buffers (donate_argnums) so updates are in-place in HBM.  The functions exist
+for API parity and report what XLA will do.
+"""
+from __future__ import annotations
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
+    if print_log:
+        print(
+            "memory_optimize: no-op on TPU — XLA buffer assignment reuses "
+            "dead buffers and the executor donates state (see executor.py)."
+        )
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

@@ -1,0 +1,42 @@
+"""Parameter-server shard dispatchers (reference:
+python/paddle/fluid/transpiler/ps_dispatcher.py)."""
+from __future__ import annotations
+
+__all__ = ["PSDispatcher", "RoundRobin", "HashName"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """Shard by hash(var name) % #pservers."""
+
+    def _hash_block(self, block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        return [self._eps[abs(hash(v.name)) % len(self._eps)] for v in varlist]
+
+
+class RoundRobin(PSDispatcher):
+    """Cycle endpoints in order."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out

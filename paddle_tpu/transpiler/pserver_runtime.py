@@ -1,0 +1,234 @@
+"""Host-side runtime for the distributed send/recv/listen_and_serv ops
+(reference analog: operators/listen_and_serv_op.cc + distributed/grpc_*).
+
+Transport is length-prefixed pickle over TCP on localhost/DCN — the dense
+parameter-server path.  (The high-throughput sparse path is the C++ pserver
+in csrc/pserver.cc.)  The pserver applies its optimize sub-block as one
+jitted XLA step per sync round; trainers overlap compute and RPC naturally
+because the send happens after the step's fetches materialize.
+
+Sync semantics: with ``Fanin`` trainers, the server barriers each round:
+grads from all trainers are summed, optimizer ops run once, then every
+trainer's pull returns the fresh params (reference sync_mode=True).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["serve", "PSClient", "run_trainer_step"]
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class PSClient:
+    """Trainer-side connection to one pserver endpoint."""
+
+    def __init__(self, endpoint, connect_timeout=60.0):
+        import time
+
+        host, port = endpoint.rsplit(":", 1)
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self.sock = socket.create_connection((host, int(port)), timeout=60)
+                return
+            except OSError:
+                # pserver may still be compiling its startup program
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+
+    def push_pull(self, grads: dict) -> dict:
+        """Send grads, barrier on the sync round, receive fresh params."""
+        _send_msg(self.sock, ("push_pull", grads))
+        reply = _recv_msg(self.sock)
+        if reply is None:
+            raise IOError("pserver closed connection")
+        return reply
+
+    def pull(self, names) -> dict:
+        _send_msg(self.sock, ("pull", list(names)))
+        return _recv_msg(self.sock)
+
+    def shutdown_server(self):
+        try:
+            _send_msg(self.sock, ("shutdown", None))
+        except OSError:
+            pass
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _SyncRound:
+    """Barrier accumulator for one optimizer application."""
+
+    def __init__(self, fanin):
+        self.fanin = fanin
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.grads = {}
+        self.count = 0
+        self.generation = 0
+
+    def submit(self, grads, apply_fn):
+        """Add one trainer's grads; the last arrival applies the optimizer.
+        Returns after the round's params are fresh."""
+        with self.cond:
+            gen = self.generation
+            for k, v in grads.items():
+                self.grads[k] = self.grads.get(k, 0) + np.asarray(v)
+            self.count += 1
+            if self.count == self.fanin:
+                apply_fn(self.grads)
+                self.grads = {}
+                self.count = 0
+                self.generation += 1
+                self.cond.notify_all()
+            else:
+                while self.generation == gen:
+                    self.cond.wait()
+
+
+def serve(executor, program, scope):
+    """Run a pserver program (a single listen_and_serv op).  Blocks until a
+    trainer sends shutdown.  Reference: Executor runs listen_and_serv_op
+    which blocks serving RPC."""
+    ls = program.global_block().ops[-1]
+    assert ls.type == "listen_and_serv"
+    endpoint = ls.attrs["endpoint"]
+    fanin = int(ls.attrs.get("Fanin", 1))
+    grad_names = list(ls.attrs["grad_names"])
+    param_names = list(ls.attrs["param_names"])
+    opt_block = ls.sub_block
+
+    # one-block program that applies the optimizer ops given grad feeds
+    from ..framework import Program
+
+    apply_prog = Program()
+    blk = apply_prog.global_block()
+    src_blk = program.global_block()
+    for n, v in src_blk.vars.items():
+        blk.create_var(name=v.name, shape=v.shape, dtype=v.dtype, persistable=v.persistable)
+    for op in opt_block.ops:
+        blk.append_op(type=op.type, inputs=dict(op.inputs), outputs=dict(op.outputs), attrs=dict(op.attrs))
+
+    def apply_fn(summed_grads):
+        executor.run(apply_prog, feed=dict(summed_grads), fetch_list=[], scope=scope)
+
+    round_ = _SyncRound(fanin)
+    stop = threading.Event()
+
+    host, port = endpoint.rsplit(":", 1)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, int(port)))
+    srv.listen(16)
+
+    def handle(conn):
+        try:
+            while not stop.is_set():
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                cmd, payload = msg
+                if cmd == "push_pull":
+                    grads = {g: payload[g] for g in grad_names if g in payload}
+                    round_.submit(grads, apply_fn)
+                    params = {p: np.asarray(scope.vars[p]) for p in param_names}
+                    _send_msg(conn, params)
+                elif cmd == "pull":
+                    _send_msg(conn, {p: np.asarray(scope.vars[p]) for p in payload if p in scope.vars})
+                elif cmd == "shutdown":
+                    stop.set()
+                    # unblock accept()
+                    try:
+                        poke = socket.create_connection((host, int(port)), timeout=5)
+                        poke.close()
+                    except OSError:
+                        pass
+                    return
+        finally:
+            conn.close()
+
+    threads = []
+    while not stop.is_set():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            break
+        if stop.is_set():
+            conn.close()
+            break
+        t = threading.Thread(target=handle, args=(conn,), daemon=True)
+        t.start()
+        threads.append(t)
+    srv.close()
+    for t in threads:
+        t.join(timeout=5)
+    return []
+
+
+def run_trainer_step(executor, program, feed, fetch_list, scope, clients):
+    """Run a transpiled trainer program: one jitted compute step, then the
+    send/recv RPC round (host side)."""
+    from ..framework import OpRole, Variable
+
+    blk = program.global_block()
+    send_op = next(op for op in blk.ops if op.type == "send")
+    recv_op = next(op for op in blk.ops if op.type == "recv")
+
+    compute = getattr(program, "_compute_clone", None)
+    if compute is None or program._compute_version != program.version:
+        compute = program.clone()
+        cblk = compute.global_block()
+        cblk.ops = [op for op in cblk.ops if op.type not in ("send", "recv")]
+        compute._bump()
+        program._compute_clone = compute
+        program._compute_version = program.version
+
+    grad_names = list(send_op.inputs["X"])
+    fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
+    res = executor.run(
+        compute, feed=feed, fetch_list=list(fetch_names) + grad_names, scope=scope
+    )
+    user_fetches = res[: len(fetch_names)]
+    grad_vals = dict(zip(grad_names, res[len(fetch_names) :]))
+
+    # group grads per endpoint
+    epmap = dict(zip(grad_names, send_op.attrs["epmap"]))
+    by_ep = {}
+    for g, v in grad_vals.items():
+        by_ep.setdefault(epmap[g], {})[g] = v
+    for ep, grads in by_ep.items():
+        fresh = clients[ep].push_pull(grads)
+        scope.vars.update(fresh)
+    return user_fetches

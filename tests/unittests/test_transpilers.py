@@ -1,0 +1,45 @@
+"""InferenceTranspiler (BN fold) + memory_optimize parity tests
+(mirrors reference test_inference_model_io / transpiler tests)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_inference_transpiler_folds_bn():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3, padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(input=conv)
+    infer = main.clone(for_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # give BN non-trivial stats
+        for n, v in list(scope.vars.items()):
+            if "batch_norm" in n and ("mean" in n or "variance" in n):
+                arr = np.asarray(v)
+                scope.vars[n] = (np.abs(rng.randn(*arr.shape)) + 0.5).astype("float32")
+        (before,) = exe.run(infer, feed={"img": x}, fetch_list=[bn])
+        t = fluid.InferenceTranspiler()
+        t.transpile(infer, scope=scope)
+        types = [op.type for op in infer.global_block().ops]
+        assert "batch_norm" not in types, types
+        (after,) = exe.run(infer, feed={"img": x}, fetch_list=[bn])
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_memory_optimize_noop():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2)
+    n_ops = len(main.global_block().ops)
+    out = fluid.memory_optimize(main)
+    assert out is main and len(main.global_block().ops) == n_ops
+    fluid.release_memory(main)
