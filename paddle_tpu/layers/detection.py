@@ -1,9 +1,286 @@
 """Detection layers (reference: python/paddle/fluid/layers/detection.py).
 
-Populated in the detection phase (SSD stack: prior_box, multi_box_head,
-box_coder, bipartite_match, target_assign, ssd_loss, detection_output,
-iou_similarity, detection mAP).
+SSD stack: prior_box, multi_box_head, iou_similarity, bipartite_match,
+box_coder, target_assign, ssd_loss, detection_output, anchor_generator.
+Ground-truth boxes/labels ride the padded+lengths ragged layout; every op is
+fixed-shape (ops/detection_ops.py), so the whole detector — including
+matching, hard-negative mining and NMS — jits into the train/eval step.
 """
 from __future__ import annotations
 
-__all__ = []
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box",
+    "multi_box_head",
+    "bipartite_match",
+    "target_assign",
+    "detection_output",
+    "ssd_loss",
+    "iou_similarity",
+    "box_coder",
+    "anchor_generator",
+]
+
+
+def iou_similarity(x, y, name=None):
+    """IoU matrix between box sets (reference detection.py:304)."""
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, name=None):
+    """Encode/decode boxes vs priors (reference detection.py:332)."""
+    helper = LayerHelper("box_coder", **locals())
+    out = helper.create_variable_for_type_inference(dtype=target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_coder",
+        inputs=inputs,
+        outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized},
+    )
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None, name=None):
+    """Greedy bipartite (+ optional per-prediction) matching
+    (reference detection.py:491)."""
+    helper = LayerHelper("bipartite_match", **locals())
+    match_indices = helper.create_variable_for_type_inference(dtype="int32", stop_gradient=True)
+    match_distance = helper.create_variable_for_type_inference(dtype=dist_matrix.dtype, stop_gradient=True)
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [match_indices], "ColToRowMatchDist": [match_distance]},
+        attrs={"match_type": match_type or "bipartite", "dist_threshold": dist_threshold or 0.5},
+    )
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None, mismatch_value=None, name=None):
+    """Gather per-prior targets from matched gt (reference detection.py:576)."""
+    helper = LayerHelper("target_assign", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_weight = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value or 0},
+    )
+    return out, out_weight
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box, prior_box_var=None,
+             background_label=0, overlap_threshold=0.5, neg_pos_ratio=3.0,
+             neg_overlap=0.5, loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """Fused SSD multibox loss (reference detection.py:662): IoU match →
+    hard-negative mining → smooth-L1 loc + softmax conf losses.  Returns
+    [batch, 1] (already normalized by total positives when ``normalize``)."""
+    if mining_type != "max_negative":
+        raise NotImplementedError("only max_negative mining is supported")
+    helper = LayerHelper("ssd_loss", **locals())
+    loss = helper.create_variable_for_type_inference(dtype=location.dtype)
+    inputs = {
+        "Loc": [location],
+        "Conf": [confidence],
+        "GTBox": [gt_box],
+        "GTLabel": [gt_label],
+        "PriorBox": [prior_box],
+    }
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss",
+        inputs=inputs,
+        outputs={"Loss": [loss]},
+        attrs={
+            "background_label": background_label,
+            "overlap_threshold": overlap_threshold,
+            "neg_pos_ratio": neg_pos_ratio,
+            "neg_overlap": neg_overlap,
+            "loc_loss_weight": loc_loss_weight,
+            "conf_loss_weight": conf_loss_weight,
+            "match_type": match_type,
+            "normalize": normalize,
+        },
+    )
+    return loss
+
+
+def detection_output(loc, scores, prior_box, prior_box_var, background_label=0,
+                     nms_threshold=0.3, nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, nms_eta=1.0):
+    """Decode + multiclass NMS (reference detection.py:190).  Returns a
+    padded ``[batch, keep_top_k, 6]`` tensor (label, score, x0, y0, x1, y1;
+    rows past each image's detection count are -1) with a lengths companion —
+    the dense analog of the reference's LoD output."""
+    helper = LayerHelper("detection_output", **locals())
+    decoded = box_coder(
+        prior_box=prior_box,
+        prior_box_var=prior_box_var,
+        target_box=loc,
+        code_type="decode_center_size",
+    )
+    from .nn import softmax, transpose
+
+    scores = softmax(input=scores)
+    scores = transpose(scores, perm=[0, 2, 1])  # [B, C, M]
+    out = helper.create_variable_for_type_inference(dtype=loc.dtype, lod_level=1, stop_gradient=True)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [decoded], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={
+            "background_label": background_label,
+            "nms_threshold": nms_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "score_threshold": score_threshold,
+            "nms_eta": nms_eta,
+        },
+    )
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes for one feature map (reference detection.py:895).
+    Output layout [H, W, num_priors, 4] (+ same-shaped variances)."""
+    helper = LayerHelper("prior_box", **locals())
+
+    def _list(v):
+        return [float(x) for x in (v if isinstance(v, (list, tuple)) else [v])]
+
+    min_sizes = _list(min_sizes)
+    max_sizes = _list(max_sizes) if max_sizes else []
+    aspect_ratios = _list(aspect_ratios)
+
+    # static output shape: priors per cell
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - o) > 1e-6 for o in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    num_priors = len(ars) * len(min_sizes) + len(max_sizes)
+    shp = None
+    if input.shape is not None and len(input.shape) == 4:
+        shp = [input.shape[2], input.shape[3], num_priors, 4]
+
+    box = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shp, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shp, stop_gradient=True)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [box], "Variances": [var]},
+        attrs={
+            "min_sizes": min_sizes,
+            "max_sizes": max_sizes,
+            "aspect_ratios": aspect_ratios,
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "steps": list(steps),
+            "offset": offset,
+            "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+        },
+    )
+    return box, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5, name=None):
+    """RPN anchors for one feature map (reference detection.py:1261)."""
+    helper = LayerHelper("anchor_generator", **locals())
+    num = len(anchor_sizes) * len(aspect_ratios)
+    shp = None
+    if input.shape is not None and len(input.shape) == 4:
+        shp = [input.shape[2], input.shape[3], num, 4]
+    anchor = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shp, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype=input.dtype, shape=shp, stop_gradient=True)
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchor], "Variances": [var]},
+        attrs={
+            "anchor_sizes": [float(a) for a in anchor_sizes],
+            "aspect_ratios": [float(a) for a in aspect_ratios],
+            "variances": list(variance),
+            "stride": [float(s) for s in stride],
+            "offset": offset,
+        },
+    )
+    return anchor, var
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None, max_sizes=None,
+                   steps=None, step_w=None, step_h=None, offset=0.5, variance=[0.1, 0.1, 0.2, 0.2],
+                   flip=True, clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps (reference
+    detection.py:1015): per-map loc/conf convs + concatenated priors.
+    Returns (mbox_locs [B, M, 4], mbox_confs [B, M, C], boxes [M, 4],
+    variances [M, 4])."""
+    from . import nn, tensor
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference: evenly spaced ratios between min_ratio% and max_ratio%
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_list, vars_list = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) else [aspect_ratios[i]]
+        st = steps[i] if steps else [step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
+        box, var = prior_box(
+            x, image, mins, maxs, ar, variance, flip, clip, st, offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order,
+        )
+        npri = int(box.shape[2])
+
+        mbox_loc = nn.conv2d(input=x, num_filters=npri * 4, filter_size=kernel_size,
+                             padding=pad, stride=stride)
+        # NCHW -> [B, H*W*num_priors, 4]
+        loc = nn.transpose(mbox_loc, perm=[0, 2, 3, 1])
+        loc = nn.reshape(loc, shape=[0, -1, 4])
+        locs.append(loc)
+
+        mbox_conf = nn.conv2d(input=x, num_filters=npri * num_classes, filter_size=kernel_size,
+                              padding=pad, stride=stride)
+        conf = nn.transpose(mbox_conf, perm=[0, 2, 3, 1])
+        conf = nn.reshape(conf, shape=[0, -1, num_classes])
+        confs.append(conf)
+
+        boxes_list.append(nn.reshape(box, shape=[-1, 4]))
+        vars_list.append(nn.reshape(var, shape=[-1, 4]))
+
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    boxes = tensor.concat(boxes_list, axis=0)
+    variances = tensor.concat(vars_list, axis=0)
+    boxes.stop_gradient = True
+    variances.stop_gradient = True
+    return mbox_locs, mbox_confs, boxes, variances

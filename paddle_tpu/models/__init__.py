@@ -14,3 +14,4 @@ from . import transformer  # noqa: F401
 from . import ocr_crnn_ctc  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import deepfm  # noqa: F401
+from . import ssd  # noqa: F401

@@ -1,0 +1,467 @@
+"""Detection op lowerings: prior boxes, IoU, bipartite matching, box
+en/decoding, target assignment, SSD loss, multiclass NMS.
+
+Reference kernels: paddle/fluid/operators/detection/{prior_box_op.h,
+iou_similarity_op.h, bipartite_match_op.cc, box_coder_op.h,
+target_assign_op.h, mine_hard_examples_op.cc, multiclass_nms_op.cc,
+anchor_generator_op.h} and python/paddle/fluid/layers/detection.py ssd_loss.
+
+TPU-native design: ground truth is padded ``[B, G, 4]`` + lengths (vs the
+reference's LoD rows); every stage is a fixed-shape masked computation —
+bipartite matching is a G-step ``lax.fori_loop`` over an IoU matrix, NMS is
+the O(k²) upper-triangular suppression matmul, and ssd_loss fuses the whole
+pipeline (match → mine → assign → losses) into the training step so XLA
+schedules it with the backbone.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _gt_lengths(ctx, op, slot, x):
+    jnp = _jnp()
+    name = op.inputs[slot][0]
+    lens = ctx.get_lengths(name)
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+    return lens.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# priors / anchors
+# ---------------------------------------------------------------------------
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - o) > 1e-6 for o in out):
+            out.append(float(ar))
+            if flip:
+                out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box_np(fm_h, fm_w, img_h, img_w, min_sizes, max_sizes, aspect_ratios,
+                 variance, flip, clip, steps, offset, min_max_order=False):
+    """Static prior-box table (reference prior_box_op.h CPU kernel) — computed
+    once at trace time with numpy; it depends only on shapes/attrs."""
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+    step_w = steps[0] or float(img_w) / fm_w
+    step_h = steps[1] or float(img_h) / fm_h
+    boxes = []
+    for h in range(fm_h):
+        for w in range(fm_w):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+
+            def add(bw, bh):
+                cell.append([
+                    (cx - bw / 2.0) / img_w, (cy - bh / 2.0) / img_h,
+                    (cx + bw / 2.0) / img_w, (cy + bh / 2.0) / img_h,
+                ])
+
+            for i, ms in enumerate(min_sizes):
+                if not min_max_order:
+                    for ar in ars:
+                        add(ms * np.sqrt(ar), ms / np.sqrt(ar))
+                    if max_sizes:
+                        s = np.sqrt(ms * max_sizes[i])
+                        add(s, s)
+                else:
+                    add(ms, ms)
+                    if max_sizes:
+                        s = np.sqrt(ms * max_sizes[i])
+                        add(s, s)
+                    for ar in ars[1:]:
+                        add(ms * np.sqrt(ar), ms / np.sqrt(ar))
+            boxes.append(cell)
+    b = np.asarray(boxes, np.float32).reshape(fm_h, fm_w, -1, 4)
+    if clip:
+        b = np.clip(b, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), b.shape).copy()
+    return b, var
+
+
+@register("prior_box")
+def _prior_box(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")  # NCHW feature map
+    img = ctx.get_input(op, "Image")
+    a = op.attrs
+    b, var = prior_box_np(
+        x.shape[2], x.shape[3], img.shape[2], img.shape[3],
+        list(a["min_sizes"]), list(a.get("max_sizes") or []),
+        list(a.get("aspect_ratios", [1.0])), list(a.get("variances", [0.1, 0.1, 0.2, 0.2])),
+        bool(a.get("flip", False)), bool(a.get("clip", False)),
+        list(a.get("steps", [0.0, 0.0])), float(a.get("offset", 0.5)),
+        bool(a.get("min_max_aspect_ratios_order", False)),
+    )
+    ctx.set_output(op, "Boxes", jnp.asarray(b))
+    ctx.set_output(op, "Variances", jnp.asarray(var))
+
+
+@register("anchor_generator")
+def _anchor_generator(ctx, op):
+    """Faster-RCNN style anchors (reference anchor_generator_op.h)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")
+    a = op.attrs
+    sizes = list(a["anchor_sizes"])
+    ratios = list(a["aspect_ratios"])
+    variances = list(a.get("variances", [0.1, 0.1, 0.2, 0.2]))
+    stride = list(a["stride"])
+    offset = float(a.get("offset", 0.5))
+    H, W = x.shape[2], x.shape[3]
+    anchors = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * stride[0]
+            cy = (h + offset) * stride[1]
+            cell = []
+            for r in ratios:
+                for s in sizes:
+                    aw = s * np.sqrt(r)
+                    ah = s / np.sqrt(r)
+                    cell.append([cx - aw / 2, cy - ah / 2, cx + aw / 2, cy + ah / 2])
+            anchors.append(cell)
+    arr = np.asarray(anchors, np.float32).reshape(H, W, -1, 4)
+    var = np.broadcast_to(np.asarray(variances, np.float32), arr.shape).copy()
+    ctx.set_output(op, "Anchors", jnp.asarray(arr))
+    ctx.set_output(op, "Variances", jnp.asarray(var))
+
+
+# ---------------------------------------------------------------------------
+# IoU / matching / coding
+# ---------------------------------------------------------------------------
+
+
+def _iou(a, b):
+    """a: [..., N, 4], b: [..., M, 4] -> [..., N, M] (xmin,ymin,xmax,ymax)."""
+    jnp = _jnp()
+    ax0, ay0, ax1, ay1 = [a[..., :, None, i] for i in range(4)]
+    bx0, by0, bx1, by1 = [b[..., None, :, i] for i in range(4)]
+    ix = jnp.maximum(jnp.minimum(ax1, bx1) - jnp.maximum(ax0, bx0), 0.0)
+    iy = jnp.maximum(jnp.minimum(ay1, by1) - jnp.maximum(ay0, by0), 0.0)
+    inter = ix * iy
+    area_a = jnp.maximum(ax1 - ax0, 0.0) * jnp.maximum(ay1 - ay0, 0.0)
+    area_b = jnp.maximum(bx1 - bx0, 0.0) * jnp.maximum(by1 - by0, 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register("iou_similarity")
+def _iou_similarity(ctx, op):
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")  # gt: [B, G, 4] (or [G,4])
+    y = ctx.get_input(op, "Y")  # priors: [M, 4]
+    if x.ndim == 2:
+        out = _iou(x, y)
+    else:
+        out = _iou(x, jnp.broadcast_to(y, (x.shape[0],) + y.shape))
+    ctx.set_output(op, "Out", out)
+    if x.ndim == 3:
+        ctx.copy_lengths(op.inputs["X"][0], op.outputs["Out"][0])
+
+
+def _bipartite_match(dist, gt_mask):
+    """Greedy global bipartite matching (reference bipartite_match_op.cc).
+
+    dist: [G, M] similarity; gt_mask: [G] bool valid gt rows.
+    Returns (match_idx [M] int32 with -1 unmatched, match_dist [M]).
+    """
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    G, M = dist.shape
+    d0 = jnp.where(gt_mask[:, None], dist, -1.0)
+
+    def body(_, state):
+        d, midx, mdist = state
+        flat = jnp.argmax(d)
+        g, m = flat // M, flat % M
+        val = d[g, m]
+        take = val > 0
+        midx = jnp.where(take, midx.at[m].set(g.astype(jnp.int32)), midx)
+        mdist = jnp.where(take, mdist.at[m].set(val), mdist)
+        # clear matched row & col
+        d = jnp.where(take, d.at[g, :].set(-1.0).at[:, m].set(-1.0), d)
+        return d, midx, mdist
+
+    midx0 = jnp.full((M,), -1, jnp.int32)
+    mdist0 = jnp.zeros((M,), dist.dtype)
+    _, midx, mdist = lax.fori_loop(0, G, body, (d0, midx0, mdist0))
+    return midx, mdist
+
+
+def _match(dist, gt_mask, match_type, overlap_threshold):
+    import jax
+
+    jnp = _jnp()
+    midx, mdist = _bipartite_match(dist, gt_mask)
+    if match_type == "per_prediction":
+        d = jnp.where(gt_mask[:, None], dist, -1.0)
+        best_g = jnp.argmax(d, axis=0).astype(jnp.int32)
+        best_v = jnp.max(d, axis=0)
+        extra = (midx < 0) & (best_v > overlap_threshold)
+        midx = jnp.where(extra, best_g, midx)
+        mdist = jnp.where(extra, best_v, mdist)
+    return midx, mdist
+
+
+@register("bipartite_match")
+def _bipartite_match_op(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    dist = ctx.get_input(op, "DistMat")  # [B, G, M] or [G, M]
+    match_type = op.attrs.get("match_type", "bipartite")
+    thr = float(op.attrs.get("dist_threshold", 0.5))
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    lens = _gt_lengths(ctx, op, "DistMat", dist)
+    G = dist.shape[1]
+    gt_mask = jnp.arange(G)[None, :] < lens[:, None]
+    midx, mdist = jax.vmap(lambda d, m: _match(d, m, match_type, thr))(dist, gt_mask)
+    if squeeze:
+        midx, mdist = midx[0], mdist[0]
+    ctx.set_output(op, "ColToRowMatchIndices", midx)
+    ctx.set_output(op, "ColToRowMatchDist", mdist)
+
+
+def _encode_box(prior, prior_var, gt):
+    """center-size encoding (reference box_coder_op.h encode_center_size)."""
+    jnp = _jnp()
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gcx = (gt[..., 0] + gt[..., 2]) / 2
+    gcy = (gt[..., 1] + gt[..., 3]) / 2
+    eps = 1e-10
+    t = jnp.stack(
+        [
+            (gcx - pcx) / jnp.maximum(pw, eps),
+            (gcy - pcy) / jnp.maximum(ph, eps),
+            jnp.log(jnp.maximum(gw / jnp.maximum(pw, eps), eps)),
+            jnp.log(jnp.maximum(gh / jnp.maximum(ph, eps), eps)),
+        ],
+        axis=-1,
+    )
+    return t / prior_var if prior_var is not None else t
+
+
+def _decode_box(prior, prior_var, code):
+    jnp = _jnp()
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    if prior_var is not None:
+        code = code * prior_var
+    cx = code[..., 0] * pw + pcx
+    cy = code[..., 1] * ph + pcy
+    w = jnp.exp(code[..., 2]) * pw
+    h = jnp.exp(code[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+@register("box_coder")
+def _box_coder(ctx, op):
+    jnp = _jnp()
+    prior = ctx.get_input(op, "PriorBox")  # [M, 4]
+    pvar = ctx.get_input(op, "PriorBoxVar", None)  # [M, 4] or None
+    target = ctx.get_input(op, "TargetBox")
+    code_type = op.attrs.get("code_type", "encode_center_size")
+    norm = bool(op.attrs.get("box_normalized", True))
+    if not norm:
+        one = jnp.asarray(1.0, prior.dtype)
+        prior = prior + jnp.stack([0 * one, 0 * one, one, one])
+    if "encode" in code_type:
+        # target: [B?, N, 4] gt; output [N, M, 4] per reference ([gt, prior])
+        out = _encode_box(prior[None, :, :], None if pvar is None else pvar[None], target[..., None, :])
+    else:
+        # decode: target [B?, M, 4] codes
+        out = _decode_box(prior, pvar, target)
+    ctx.set_output(op, "OutputBox", out)
+
+
+@register("target_assign")
+def _target_assign(ctx, op):
+    """Gather per-prior targets from matched gt rows
+    (reference target_assign_op.h).  X: [B, G, K] gt attr (padded),
+    MatchIndices: [B, M]; out [B, M, K], weight [B, M, 1]."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")
+    midx = ctx.get_input(op, "MatchIndices")
+    mismatch_value = op.attrs.get("mismatch_value", 0)
+    B, M = midx.shape
+    safe = jnp.clip(midx, 0, x.shape[1] - 1)
+    out = jnp.take_along_axis(x, safe[:, :, None], axis=1)
+    matched = (midx >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch_value, x.dtype))
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "OutWeight", matched.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# SSD loss (fused pipeline)
+# ---------------------------------------------------------------------------
+
+
+@register("ssd_loss")
+def _ssd_loss(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    loc = ctx.get_input(op, "Loc")  # [B, M, 4]
+    conf = ctx.get_input(op, "Conf")  # [B, M, C]
+    gt_box = ctx.get_input(op, "GTBox")  # [B, G, 4] padded
+    gt_label = ctx.get_input(op, "GTLabel")  # [B, G] or [B, G, 1]
+    prior = ctx.get_input(op, "PriorBox")  # [M, 4]
+    pvar = ctx.get_input(op, "PriorBoxVar", None)
+    a = op.attrs
+    background = int(a.get("background_label", 0))
+    overlap_t = float(a.get("overlap_threshold", 0.5))
+    neg_pos_ratio = float(a.get("neg_pos_ratio", 3.0))
+    neg_overlap = float(a.get("neg_overlap", 0.5))
+    loc_w = float(a.get("loc_loss_weight", 1.0))
+    conf_w = float(a.get("conf_loss_weight", 1.0))
+    match_type = a.get("match_type", "per_prediction")
+    normalize = bool(a.get("normalize", True))
+
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_label = gt_label.astype(jnp.int32)
+    lens = _gt_lengths(ctx, op, "GTBox", gt_box)
+    B, M = loc.shape[0], loc.shape[1]
+    G = gt_box.shape[1]
+    C = conf.shape[-1]
+    gt_mask = jnp.arange(G)[None, :] < lens[:, None]  # [B, G]
+
+    iou = _iou(gt_box.astype(jnp.float32), jnp.broadcast_to(prior, (B,) + prior.shape))  # [B,G,M]
+    midx, mdist = jax.vmap(lambda d, m: _match(d, m, match_type, overlap_t))(iou, gt_mask)
+
+    pos = midx >= 0  # [B, M]
+    safe = jnp.clip(midx, 0, G - 1)
+    tgt_label = jnp.where(pos, jnp.take_along_axis(gt_label, safe, axis=1), background)
+
+    logits = conf.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    conf_loss = -jnp.take_along_axis(logp, tgt_label[:, :, None], axis=2)[:, :, 0]  # [B, M]
+
+    # hard negative mining (reference mine_hard_examples_op, max_negative):
+    # rank negatives by conf loss desc, keep neg_pos_ratio * num_pos
+    num_pos = pos.astype(jnp.int32).sum(axis=1)  # [B]
+    neg_cand = (~pos) & (mdist < neg_overlap)
+    neg_loss = jnp.where(neg_cand, conf_loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)  # [B, M] indices by loss desc
+    rank = jnp.argsort(order, axis=1)  # rank of each prior among negatives
+    num_neg = jnp.minimum(
+        (neg_pos_ratio * num_pos.astype(jnp.float32)).astype(jnp.int32),
+        neg_cand.astype(jnp.int32).sum(axis=1),
+    )
+    neg_sel = neg_cand & (rank < num_neg[:, None])
+
+    # localization loss (smooth L1) on positives
+    tgt_box = jnp.take_along_axis(gt_box.astype(jnp.float32), safe[:, :, None], axis=1)  # [B,M,4]
+    enc = _encode_box(prior[None], None if pvar is None else pvar[None], tgt_box)
+    diff = loc.astype(jnp.float32) - enc
+    ad = jnp.abs(diff)
+    smooth = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(axis=-1)  # [B, M]
+    loc_loss = (smooth * pos.astype(jnp.float32)).sum(axis=1)
+
+    conf_total = (conf_loss * (pos | neg_sel).astype(jnp.float32)).sum(axis=1)
+    total = loc_w * loc_loss + conf_w * conf_total  # [B]
+    if normalize:
+        denom = jnp.maximum(num_pos.astype(jnp.float32).sum(), 1.0)
+        total = total / denom
+    ctx.set_output(op, "Loss", total[:, None])
+
+
+# ---------------------------------------------------------------------------
+# detection_output: decode + multiclass NMS
+# ---------------------------------------------------------------------------
+
+
+def _nms_mask(boxes, scores, iou_threshold, top_k):
+    """Greedy NMS keep-mask over the top_k scored boxes (static shape).
+
+    boxes [K, 4] sorted by score desc; returns keep [K] bool.  Classic
+    O(K²) suppression: box j is kept iff no higher-scoring *kept* box
+    overlaps it above threshold — computed with a lax.fori_loop carrying the
+    keep mask (matches multiclass_nms_op.cc semantics exactly).
+    """
+    from jax import lax
+
+    jnp = _jnp()
+    K = boxes.shape[0]
+    iou = _iou(boxes, boxes)  # [K, K]
+    over = iou > iou_threshold
+
+    def body(j, keep):
+        # j suppressed if any kept i<j overlaps it
+        sup = (over[:, j] & keep & (jnp.arange(K) < j)).any()
+        return keep.at[j].set(keep[j] & ~sup)
+
+    keep0 = scores > -jnp.inf
+    return lax.fori_loop(0, K, body, keep0)
+
+
+@register("multiclass_nms")
+def _multiclass_nms(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    bboxes = ctx.get_input(op, "BBoxes")  # [B, M, 4] decoded
+    scores = ctx.get_input(op, "Scores")  # [B, C, M]
+    a = op.attrs
+    background = int(a.get("background_label", 0))
+    score_t = float(a.get("score_threshold", 0.01))
+    nms_t = float(a.get("nms_threshold", 0.3))
+    nms_top_k = int(a.get("nms_top_k", 400))
+    keep_top_k = int(a.get("keep_top_k", 200))
+
+    B, C, M = scores.shape
+    k = min(nms_top_k, M)
+
+    def per_class(boxes, sc):
+        # sc: [M] one class's scores
+        val, idx = jax.lax.top_k(jnp.where(sc > score_t, sc, -jnp.inf), k)
+        bx = boxes[idx]
+        keep = _nms_mask(bx, val, nms_t, k) & (val > -jnp.inf)
+        return val, idx, keep
+
+    def per_image(boxes, sc):
+        vals, idxs, keeps = jax.vmap(lambda s: per_class(boxes, s))(sc)  # [C, k]
+        cls = jnp.broadcast_to(jnp.arange(C)[:, None], (C, k))
+        flat_v = jnp.where(keeps & (cls != background), vals, -jnp.inf).reshape(-1)
+        flat_i = idxs.reshape(-1)
+        flat_c = cls.reshape(-1)
+        kk = min(keep_top_k, flat_v.shape[0])
+        top_v, sel = jax.lax.top_k(flat_v, kk)
+        out_boxes = boxes[flat_i[sel]]
+        out = jnp.concatenate(
+            [flat_c[sel][:, None].astype(boxes.dtype), top_v[:, None], out_boxes], axis=1
+        )
+        valid = top_v > -jnp.inf
+        out = jnp.where(valid[:, None], out, -1.0)
+        return out, valid.astype(jnp.int32).sum()
+
+    outs, counts = jax.vmap(per_image)(bboxes, scores)
+    name = op.outputs["Out"][0]
+    ctx.set_output(op, "Out", outs)  # [B, keep_top_k, 6]
+    ctx.set_lengths(name, counts)

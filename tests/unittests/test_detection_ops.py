@@ -1,0 +1,165 @@
+"""Detection op tests vs NumPy references (mirrors reference
+test_prior_box_op / test_iou_similarity_op / test_bipartite_match_op /
+test_box_coder_op / test_ssd_loss / test_multiclass_nms_op)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDArray
+
+
+def _run(build, feeds):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=list(outs))
+
+
+def _iou_np(a, b):
+    out = np.zeros((len(a), len(b)))
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            ix = max(min(x[2], y[2]) - max(x[0], y[0]), 0)
+            iy = max(min(x[3], y[3]) - max(x[1], y[1]), 0)
+            inter = ix * iy
+            u = (x[2] - x[0]) * (x[3] - x[1]) + (y[2] - y[0]) * (y[3] - y[1]) - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+
+    def rand_boxes(n):
+        xy = rng.rand(n, 2) * 0.5
+        wh = rng.rand(n, 2) * 0.5
+        return np.concatenate([xy, xy + wh], 1).astype("float32")
+
+    a, b = rand_boxes(5), rand_boxes(7)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[5, 4], dtype="float32", append_batch_size=False)
+        y = fluid.layers.data(name="y", shape=[7, 4], dtype="float32", append_batch_size=False)
+        return [fluid.layers.iou_similarity(x=x, y=y)]
+
+    (out,) = _run(build, {"x": a, "y": b})
+    np.testing.assert_allclose(out, _iou_np(a, b), rtol=1e-5)
+
+
+def test_prior_box_shapes_and_values():
+    def build():
+        img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+        fm = fluid.layers.data(name="fm", shape=[8, 4, 4], dtype="float32")
+        box, var = fluid.layers.prior_box(
+            input=fm, image=img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True,
+        )
+        return [box, var]
+
+    fm = np.zeros((1, 8, 4, 4), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    box, var = _run(build, {"img": img, "fm": fm})
+    # priors per cell: ars {1, 2, 1/2} * 1 min + 1 max = 4
+    assert box.shape == (4, 4, 4, 4) and var.shape == box.shape
+    assert box.min() >= 0 and box.max() <= 1  # clipped
+    # center of cell (0,0) with step 8: (4, 4) -> min box [0, 0, 8, 8]/32
+    np.testing.assert_allclose(box[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+    assert np.allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_bipartite_match_greedy():
+    # dist rows=gt, cols=priors
+    dist = np.array([[[0.9, 0.2, 0.1], [0.8, 0.7, 0.3]]], "float32")  # [1, 2, 3]
+
+    def build():
+        d = fluid.layers.data(name="d", shape=[2, 3], lod_level=1, dtype="float32")
+        i, m = fluid.layers.bipartite_match(d)
+        return [i, m]
+
+    idx, mdist = _run(build, {"d": LoDArray(dist, np.array([2], np.int32))})
+    # greedy: (0,0)=0.9 first, then gt1 -> col1 (0.7)
+    assert list(idx[0]) == [0, 1, -1]
+    np.testing.assert_allclose(mdist[0], [0.9, 0.7, 0.0], rtol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    M = 6
+    prior = np.sort(rng.rand(M, 2, 2), axis=1).reshape(M, 4).astype("float32")
+    pvar = np.full((M, 4), 0.1, "float32")
+    codes = (rng.randn(1, M, 4) * 0.2).astype("float32")
+
+    def build():
+        p = fluid.layers.data(name="p", shape=[M, 4], dtype="float32", append_batch_size=False)
+        v = fluid.layers.data(name="v", shape=[M, 4], dtype="float32", append_batch_size=False)
+        c = fluid.layers.data(name="c", shape=[M, 4], dtype="float32")
+        dec = fluid.layers.box_coder(prior_box=p, prior_box_var=v, target_box=c,
+                                     code_type="decode_center_size")
+        enc = fluid.layers.box_coder(prior_box=p, prior_box_var=v, target_box=dec,
+                                     code_type="encode_center_size")
+        return [dec, enc]
+
+    dec, enc = _run(build, {"p": prior, "v": pvar, "c": codes})
+    # encode(decode(c)) == c ; enc layout [N, M, 4] with diag = roundtrip
+    for m in range(M):
+        np.testing.assert_allclose(enc[0, m, m], codes[0, m], rtol=1e-3, atol=1e-4)
+
+
+def test_ssd_loss_and_detection_output_run():
+    rng = np.random.RandomState(0)
+    B, M, C, G = 2, 24, 5, 3
+    prior = np.sort(rng.rand(M, 2, 2), axis=1).reshape(M, 4).astype("float32")
+    pvar = np.full((M, 4), 0.1, "float32")
+    loc = (rng.randn(B, M, 4) * 0.1).astype("float32")
+    conf = rng.randn(B, M, C).astype("float32")
+    gt_box = np.sort(rng.rand(B, G, 2, 2), axis=2).reshape(B, G, 4).astype("float32")
+    gt_label = rng.randint(1, C, size=(B, G)).astype("int64")
+    lens = np.array([3, 2], np.int32)
+
+    def build():
+        l = fluid.layers.data(name="l", shape=[M, 4], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[M, C], dtype="float32")
+        gb = fluid.layers.data(name="gb", shape=[4], lod_level=1, dtype="float32")
+        gl = fluid.layers.data(name="gl", shape=[1], lod_level=1, dtype="int64")
+        p = fluid.layers.data(name="p", shape=[M, 4], dtype="float32", append_batch_size=False)
+        pv = fluid.layers.data(name="pv", shape=[M, 4], dtype="float32", append_batch_size=False)
+        loss = fluid.layers.ssd_loss(l, c, gb, gl, p, pv)
+        out = fluid.layers.detection_output(l, c, p, pv, nms_threshold=0.45, keep_top_k=10)
+        return [loss, out]
+
+    loss, out = _run(build, {
+        "l": loc, "c": conf, "gb": LoDArray(gt_box, lens), "gl": LoDArray(gt_label, lens),
+        "p": prior, "pv": pvar,
+    })
+    assert loss.shape == (B, 1) and np.isfinite(loss).all() and (loss > 0).all()
+    assert out.shape == (B, 10, 6)
+    valid = out[out[:, :, 0] >= 0]
+    if len(valid):
+        assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()  # scores
+        assert (valid[:, 0] >= 1).all()  # background excluded
+
+
+def test_nms_suppresses_overlaps():
+    # two near-identical boxes + one distinct: NMS keeps 2
+    prior = np.array([[0.1, 0.1, 0.4, 0.4], [0.1, 0.1, 0.41, 0.41], [0.6, 0.6, 0.9, 0.9]], "float32")
+    B, M, C = 1, 3, 2
+    loc = np.zeros((B, M, 4), "float32")  # decode -> priors themselves
+    conf = np.zeros((B, M, C), "float32")
+    conf[0, :, 1] = [5.0, 4.0, 3.0]  # class-1 scores
+    conf[0, :, 0] = -5.0
+
+    def build():
+        l = fluid.layers.data(name="l", shape=[M, 4], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[M, C], dtype="float32")
+        p = fluid.layers.data(name="p", shape=[M, 4], dtype="float32", append_batch_size=False)
+        out = fluid.layers.detection_output(l, c, p, None, nms_threshold=0.5, keep_top_k=5)
+        return [out]
+
+    (out,) = _run(build, {"l": loc, "c": conf, "p": prior})
+    kept = out[0][out[0, :, 0] >= 0]
+    assert len(kept) == 2, out
+    np.testing.assert_allclose(kept[0, 2:], prior[0], atol=1e-5)
+    np.testing.assert_allclose(kept[1, 2:], prior[2], atol=1e-5)
