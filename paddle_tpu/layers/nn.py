@@ -24,6 +24,7 @@ __all__ = [
     "chunk_eval",
     "nce",
     "hsigmoid",
+    "flash_attention",
     "beam_search",
     "beam_search_decode",
     "embedding",
@@ -1604,5 +1605,24 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None, name=No
         inputs=inputs,
         outputs={"Out": [out], "PreOut": [pre_out]},
         attrs={"num_classes": int(num_classes)},
+    )
+    return out
+
+
+def flash_attention(q, k, v, kv_lens=None, causal=False, name=None):
+    """Fused flash attention over [batch, heads, time, head_dim] tensors
+    (pallas TPU kernel; see parallel/flash_attention.py).  ``kv_lens``
+    ([batch] int) applies a key padding mask without building a [T, S]
+    bias.  No reference analog — the reference composes matmul+softmax."""
+    helper = LayerHelper("flash_attention", **locals())
+    out = helper.create_variable_for_type_inference(dtype=q.dtype, shape=q.shape)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if kv_lens is not None:
+        inputs["KVLens"] = [kv_lens]
+    helper.append_op(
+        type="flash_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"causal": causal},
     )
     return out

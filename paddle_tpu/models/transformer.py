@@ -77,6 +77,9 @@ def multi_head_attention(
     n_head,
     dropout_rate=0.0,
     cache=None,
+    use_flash=False,
+    flash_causal=False,
+    kv_lens=None,
 ):
     """Reference transformer_model.py:45 multi_head_attention.  [B,T,D] in,
     [B,T,D] out; heads split via reshape+transpose (layout-only, free on TPU).
@@ -101,13 +104,17 @@ def multi_head_attention(
         k = cache["k"] = layers.concat([cache["k"], k], axis=2)
         v = cache["v"] = layers.concat([cache["v"], v], axis=2)
 
-    product = layers.matmul(x=q, y=k, transpose_y=True, alpha=d_key**-0.5)
-    if attn_bias is not None:
-        product = layers.elementwise_add(x=product, y=attn_bias)
-    weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate, is_test=False)
-    ctx = layers.matmul(weights, v)  # [B,H,Tq,dv]
+    if use_flash and cache is None:
+        # fused pallas kernel: padding via kv_lens, no [T,S] bias tensor
+        ctx = layers.flash_attention(q, k, v, kv_lens=kv_lens, causal=flash_causal)
+    else:
+        product = layers.matmul(x=q, y=k, transpose_y=True, alpha=d_key**-0.5)
+        if attn_bias is not None:
+            product = layers.elementwise_add(x=product, y=attn_bias)
+        weights = layers.softmax(product)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate, is_test=False)
+        ctx = layers.matmul(weights, v)  # [B,H,Tq,dv]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     b, t = queries.shape[0], queries.shape[1]
     ctx = layers.reshape(x=ctx, shape=[b if b and b > 0 else -1, t, n_head * d_value])
@@ -152,19 +159,24 @@ def prepare_encoder_decoder(
     return out
 
 
-def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner, dropout):
-    attn = multi_head_attention(x, None, None, attn_bias, d_key, d_value, d_model, n_head, dropout)
+def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner, dropout,
+                  use_flash=False, kv_lens=None):
+    attn = multi_head_attention(x, None, None, attn_bias, d_key, d_value, d_model, n_head, dropout,
+                                use_flash=use_flash, kv_lens=kv_lens)
     x = post_process(x, attn, dropout)
     ffn = positionwise_feed_forward(x, d_inner, d_model, dropout)
     return post_process(x, ffn, dropout)
 
 
 def decoder_layer(
-    x, enc_out, slf_bias, dec_enc_bias, n_head, d_key, d_value, d_model, d_inner, dropout, cache=None
+    x, enc_out, slf_bias, dec_enc_bias, n_head, d_key, d_value, d_model, d_inner, dropout, cache=None,
+    use_flash=False, trg_lens=None, src_lens=None,
 ):
-    slf = multi_head_attention(x, None, None, slf_bias, d_key, d_value, d_model, n_head, dropout, cache=cache)
+    slf = multi_head_attention(x, None, None, slf_bias, d_key, d_value, d_model, n_head, dropout, cache=cache,
+                               use_flash=use_flash, flash_causal=True, kv_lens=trg_lens)
     x = post_process(x, slf, dropout)
-    cross = multi_head_attention(x, enc_out, None, dec_enc_bias, d_key, d_value, d_model, n_head, dropout)
+    cross = multi_head_attention(x, enc_out, None, dec_enc_bias, d_key, d_value, d_model, n_head, dropout,
+                                 use_flash=use_flash, kv_lens=src_lens)
     x = post_process(x, cross, dropout)
     ffn = positionwise_feed_forward(x, d_inner, d_model, dropout)
     return post_process(x, ffn, dropout)
@@ -178,6 +190,16 @@ def _pad_bias(word_ids):
     return layers.unsqueeze(bias, axes=[1, 2])
 
 
+def _word_lens(word_ids):
+    """[B] int32 non-pad lengths (padding is contiguous at the tail)."""
+    pad = layers.fill_constant(shape=[1], dtype=word_ids.dtype, value=PAD_IDX)
+    non_pad = layers.cast(layers.logical_not(layers.equal(word_ids, pad)), "float32")
+    lens = layers.reduce_sum(non_pad, dim=1)
+    lens = layers.cast(lens, "int32")
+    lens.stop_gradient = True
+    return lens
+
+
 def wrap_encoder(
     src_word,
     src_vocab_size=SRC_VOCAB,
@@ -187,12 +209,15 @@ def wrap_encoder(
     d_model=D_MODEL,
     d_inner=D_INNER,
     dropout=DROPOUT,
+    use_flash=False,
 ):
     pos_table = _const_table("src_pos_enc_table", _position_encoding_table(max_length, d_model))
     src_bias = _pad_bias(src_word)
+    src_lens = _word_lens(src_word) if use_flash else None
     x = prepare_encoder_decoder(src_word, src_vocab_size, d_model, max_length, dropout, pos_table, "src_word_emb")
     for _ in range(n_layer):
-        x = encoder_layer(x, src_bias, n_head, d_model // n_head, d_model // n_head, d_model, d_inner, dropout)
+        x = encoder_layer(x, src_bias, n_head, d_model // n_head, d_model // n_head, d_model, d_inner, dropout,
+                          use_flash=use_flash, kv_lens=src_lens)
     return x, src_bias
 
 
@@ -209,9 +234,13 @@ def wrap_decoder(
     dropout=DROPOUT,
     caches=None,
     causal=True,
+    use_flash=False,
+    src_word=None,
 ):
     pos_table = _const_table("trg_pos_enc_table", _position_encoding_table(max_length, d_model))
     seq_len = trg_word.shape[1]
+    trg_lens = _word_lens(trg_word) if use_flash else None
+    src_lens = _word_lens(src_word) if (use_flash and src_word is not None) else None
     slf_bias = _pad_bias(trg_word)  # [B,1,1,T]
     if causal:
         causal_table = _const_table("causal_bias_table", _causal_bias_table(max_length))
@@ -232,6 +261,9 @@ def wrap_decoder(
             d_inner,
             dropout,
             cache=caches[i] if caches is not None else None,
+            use_flash=use_flash and caches is None and causal,
+            trg_lens=trg_lens,
+            src_lens=src_lens,
         )
     logits = layers.fc(input=x, size=trg_vocab_size, num_flatten_dims=2, bias_attr=False)
     return logits
@@ -250,11 +282,14 @@ def transformer(
     d_inner=D_INNER,
     dropout=DROPOUT,
     label_smooth_eps=0.1,
+    use_flash=False,
 ):
     """Training graph (reference transformer_model.py:282 transformer).
     Returns (avg_cost, sum_cost, token_count, logits)."""
-    enc_out, src_bias = wrap_encoder(src_word, src_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout)
-    logits = wrap_decoder(trg_word, enc_out, src_bias, trg_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout)
+    enc_out, src_bias = wrap_encoder(src_word, src_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout,
+                                     use_flash=use_flash)
+    logits = wrap_decoder(trg_word, enc_out, src_bias, trg_vocab_size, max_length, n_layer, n_head, d_model, d_inner,
+                          dropout, use_flash=use_flash, src_word=src_word)
 
     label = layers.one_hot(input=lbl_word, depth=trg_vocab_size)
     if label_smooth_eps:
@@ -285,6 +320,7 @@ def get_model(
     dropout=DROPOUT,
     learning_rate=2.0,
     warmup_steps=8000,
+    use_flash=False,
 ):
     import paddle_tpu as fluid
 
@@ -298,6 +334,7 @@ def get_model(
             src_word, trg_word, lbl_word,
             src_vocab_size, trg_vocab_size, max_length,
             n_layer, n_head, d_model, d_inner, dropout,
+            use_flash=use_flash,
         )
         inference_program = main.clone(for_test=True)
         lr = layers.scale(x=layers.noam_decay(d_model, warmup_steps), scale=float(learning_rate))

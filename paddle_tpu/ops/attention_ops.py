@@ -1,0 +1,27 @@
+"""Fused attention op lowering (pallas flash attention kernel).
+
+No reference analog op: the reference composes matmul+softmax+matmul
+(nets.py:233).  ``flash_attention`` is the TPU-native fused path —
+O(T) HBM per row block instead of materializing the [T, S] score matrix —
+exposed as a first-class op so Programs (transformer, seq2seq) can opt in.
+"""
+from __future__ import annotations
+
+from ..registry import register
+
+
+@register("flash_attention")
+def _flash_attention(ctx, op):
+    import jax.numpy as jnp
+
+    from ..parallel.flash_attention import flash_attention
+
+    q = ctx.get_input(op, "Q")  # [B, H, T, D]
+    k = ctx.get_input(op, "K")
+    v = ctx.get_input(op, "V")
+    kv_lens = ctx.get_input(op, "KVLens", None)  # [B] int, optional
+    if kv_lens is not None:
+        kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
+    causal = bool(op.attrs.get("causal", False))
+    out = flash_attention(q, k, v, kv_lens, causal)
+    ctx.set_output(op, "Out", out)

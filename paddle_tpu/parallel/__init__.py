@@ -1,0 +1,23 @@
+"""TPU parallelism layer: collectives (NCCL-equivalent surface), pallas
+flash attention, ring attention (sequence parallelism), tensor-parallel
+sharding helpers.
+
+Reference analog: paddle/fluid/platform/nccl_helper.h, ParallelExecutor's
+multi-GPU machinery; redesigned as mesh + XLA collectives per SURVEY §2.4.
+"""
+from . import collective  # noqa: F401
+
+__all__ = ["collective"]
+
+
+def __getattr__(name):
+    # lazy: flash/ring import jax at module import time
+    if name in ("flash_attention", "mha_reference"):
+        from . import flash_attention as fa
+
+        return getattr(fa, name)
+    if name in ("ring_attention", "ring_attention_sharded"):
+        from . import ring_attention as ra
+
+        return getattr(ra, name)
+    raise AttributeError(name)

@@ -1,0 +1,105 @@
+"""Collective communication surface (the NCCL-equivalent layer).
+
+Reference analog: paddle/fluid/platform/nccl_helper.h + the NCCL all-reduce
+inside ParallelExecutor (details/all_reduce_op_handle.cc).  On TPU these are
+XLA collectives over ICI — thin wrappers around ``jax.lax`` so framework
+code never imports jax directly, plus mesh helpers shared by
+ParallelExecutor / ring attention / the dryrun harness.
+
+All functions are *traceable*: call them inside jit/shard_map with a named
+mesh axis.  XLA lowers them onto the ICI rings (or DCN when the mesh spans
+hosts via jax.distributed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "all_reduce",
+    "psum",
+    "pmean",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+    "make_mesh",
+    "device_count",
+]
+
+
+def psum(x, axis_name):
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+all_reduce = psum  # reference spelling
+
+
+def pmean(x, axis_name):
+    import jax
+
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    import jax
+
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name):
+    import jax
+
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    import jax
+
+    return jax.lax.psum(1, axis_name)
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()
+
+
+def make_mesh(axes, devices=None):
+    """Build a ``jax.sharding.Mesh`` from {axis_name: size} (insertion
+    ordered).  A -1 size absorbs the remaining devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError("mesh %r needs %d devices, have %d" % (axes, total, len(devices)))
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))

@@ -1,0 +1,228 @@
+"""Flash attention: Pallas TPU kernel forward + blockwise JAX backward.
+
+Reference analog: the reference computes attention as separate
+matmul/softmax/matmul ops (nets.py scaled_dot_product_attention,
+operators/math/softmax.cu) — O(T²) HBM traffic.  Here the forward is a
+single Pallas kernel (online softmax, O(T) HBM per row block, MXU-shaped
+q·kᵀ and p·v tiles in VMEM) and the backward is the standard flash
+recomputation as a `lax.scan` over key blocks (no T×T materialization) so
+XLA schedules it without a hand-written bwd kernel.
+
+Supports causal masking and per-sequence key lengths (`kv_lens`) — the
+padding-mask case of the Fluid transformer — without materializing any
+[T, S] bias tensor.  On CPU (tests) the same kernel runs under
+``interpret=True``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+__all__ = ["flash_attention", "mha_reference"]
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def mha_reference(q, k, v, causal=False, sm_scale=None, kv_lens=None):
+    """Plain XLA attention (for testing / tiny shapes). [B, H, T, D]."""
+    import jax.numpy as jnp
+
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    T, S = s.shape[-2], s.shape[-1]
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        s = jnp.where(mask, s, NEG_INF)
+    if kv_lens is not None:
+        mask = jnp.arange(S)[None, :] < kv_lens[:, None]  # [B, S]
+        s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _fwd_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_k_blocks):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kvl = kvlen_ref[0]  # valid key length for this (batch, head)
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)  # [bk, d]
+    # zero invalid k/v rows: 0·NaN from OOB-padded tail tiles would poison
+    # the p·v accumulation even where p is 0
+    kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+    k = jnp.where(kcol < kvl, k, 0.0)
+    v = jnp.where(kcol < kvl, v, 0.0)
+
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    ok = col < kvl
+    if causal:
+        ok = ok & (row >= col)
+    s = jnp.where(ok, s, NEG_INF)
+
+    m_prev = m_scr[:]  # [bq, 1]
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_scr[:] * alpha + p.sum(axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(denom))[:, 0]
+
+
+def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    bq = min(block_q, T)
+    bk = min(block_k, S)
+    nq = -(-T // bq)
+    nk = -(-S // bk)
+    bh = B * H
+    qr = q.reshape(bh, T, D)
+    kr = k.reshape(bh, S, D)
+    vr = v.reshape(bh, S, D)
+    if kv_lens is None:
+        lens_bh = jnp.full((bh, 1), S, jnp.int32)
+    else:
+        lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), H).reshape(bh, 1)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, num_k_blocks=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, T, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lens_bh, qr, kr, vr)
+    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+def _flash_bwd(causal, sm_scale, block_k, res, do):
+    """Blockwise flash backward in plain JAX (lax.scan over key blocks)."""
+    import jax.numpy as jnp
+
+    q, k, v, kv_lens, out, lse = res
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = (dof * out.astype(jnp.float32)).sum(-1)  # [B,H,T]
+
+    bk = min(block_k, S)
+    nk = -(-S // bk)
+    pad = nk * bk - S
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kf.reshape(B, H, nk, bk, D)
+    vb = vf.reshape(B, H, nk, bk, D)
+
+    col_base = jnp.arange(nk) * bk
+    rows = jnp.arange(T)
+    klim = jnp.full((B,), S, jnp.int32) if kv_lens is None else kv_lens.astype(jnp.int32)
+
+    def kblock(dq, it):
+        kj, vj, j0 = it  # [B,H,bk,D], [B,H,bk,D], scalar col offset
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj) * sm_scale
+        cols = j0 + jnp.arange(bk)
+        valid = cols[None, None, None, :] < klim[:, None, None, None]
+        if causal:
+            valid = valid & (rows[:, None] >= cols[None, :])[None, None]
+        p = jnp.where(valid, jnp.exp(s - lse[..., :, None]), 0.0)  # [B,H,T,bk]
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vj)
+        ds = p * (dp - delta[..., :, None]) * sm_scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj)
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    its = (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0), col_base)
+    dq, (dk_b, dv_b) = jax.lax.scan(kblock, dq0, its)
+    dk = jnp.moveaxis(dk_b, 0, 2).reshape(B, H, nk * bk, D)[:, :, :S]
+    dv = jnp.moveaxis(dv_b, 0, 2).reshape(B, H, nk * bk, D)[:, :, :S]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention(q, k, v, kv_lens=None, causal=False, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Fused attention, [B, H, T, D] → [B, H, T, D].  ``kv_lens`` ([B] int32)
+    masks keys past each sequence's length (padding mask)."""
+    out, _ = _flash_impl(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_impl(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_impl(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, kv_lens, out, lse)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(res[0].shape[-1]))
+    dq, dk, dv = _flash_bwd(causal, sm_scale, block_k, res, do)
+    kv_lens = res[3]
+    dlens = None
+    if kv_lens is not None:
+        dlens = np.zeros(kv_lens.shape, jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
