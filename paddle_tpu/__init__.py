@@ -33,6 +33,7 @@ from . import profiler
 from . import lod as lod_tensor_mod
 from . import dataset
 from . import transpiler
+from . import parallel
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, InferenceTranspiler, memory_optimize, release_memory
 from . import reader
 from .reader import batch
