@@ -1,0 +1,306 @@
+"""High-level Trainer / Inferencer with event callbacks, step-versioned
+checkpoints and heartbeat-based failure detection.
+
+Reference: python/paddle/fluid/contrib/trainer.py (Trainer, the four
+*Event classes, CheckpointConfig) and contrib/inferencer.py.  The
+checkpoint format here is the io.py npz layout plus a JSON meta (epoch,
+step) — step-versioned directories with rotation, resumable mid-training;
+the reference's pserver-side checkpoint_notify is replaced by local
+heartbeat files any supervisor can scan (detect_failed_trainers).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from . import io as io_mod
+from . import unique_name
+from .data_feeder import DataFeeder
+from .executor import Executor, Scope, global_scope, scope_guard
+from .framework import Program, default_main_program, default_startup_program, program_guard
+
+__all__ = [
+    "BeginEpochEvent",
+    "EndEpochEvent",
+    "BeginStepEvent",
+    "EndStepEvent",
+    "CheckpointConfig",
+    "Trainer",
+    "Inferencer",
+    "save_checkpoint",
+    "load_checkpoint",
+    "Heartbeat",
+    "detect_failed_trainers",
+]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3, epoch_interval=1, step_interval=10):
+        assert epoch_interval >= 1 and step_interval >= 1
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+def _serials(dirname):
+    out = []
+    if os.path.isdir(dirname):
+        for n in os.listdir(dirname):
+            if n.startswith("checkpoint_") and n[11:].isdigit():
+                out.append(int(n[11:]))
+    return sorted(out)
+
+
+def save_checkpoint(executor, dirname, main_program, serial, meta, max_num=3):
+    """Write checkpoint_<serial>/ {params.npz, meta.json}; rotate old ones."""
+    cdir = os.path.join(dirname, "checkpoint_%d" % serial)
+    os.makedirs(cdir, exist_ok=True)
+    io_mod.save_persistables(executor, cdir, main_program=main_program, filename="params")
+    with open(os.path.join(cdir, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    for old in _serials(dirname)[:-max_num]:
+        shutil.rmtree(os.path.join(dirname, "checkpoint_%d" % old), ignore_errors=True)
+    return cdir
+
+
+def load_checkpoint(executor, dirname, main_program, serial=None):
+    """Load the given (or latest) checkpoint; returns its meta dict."""
+    serials = _serials(dirname)
+    if not serials:
+        raise IOError("no checkpoints under %r" % dirname)
+    serial = serials[-1] if serial is None else serial
+    cdir = os.path.join(dirname, "checkpoint_%d" % serial)
+    io_mod.load_persistables(executor, cdir, main_program=main_program, filename="params")
+    with open(os.path.join(cdir, "meta.json")) as f:
+        meta = json.load(f)
+    meta["serial"] = serial
+    return meta
+
+
+class Trainer:
+    """train_func() -> loss (first) + extra fetch vars; optimizer_func() ->
+    Optimizer.  Runs the loop, fires events, checkpoints, resumes."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None, place=None,
+                 parallel=False, checkpoint_config=None):
+        from .core import TPUPlace
+
+        self.place = place if place is not None else TPUPlace()
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+
+        # deterministic var names per Trainer instance (several trainers can
+        # coexist in one process, e.g. train-then-infer or resume tests)
+        with unique_name.guard():
+            with program_guard(self.train_program, self.startup_program):
+                outs = train_func()
+                if not isinstance(outs, (list, tuple)):
+                    outs = [outs]
+                self.train_func_outputs = list(outs)
+                self.loss = outs[0]
+                optimizer = optimizer_func()
+                optimizer.minimize(self.loss)
+
+        self.test_program = self.train_program.clone(for_test=True)
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                io_mod.load_persistables(self.exe, param_path, main_program=self.train_program)
+        self._epoch_start, self._step_start = 0, 0
+        self._serial_start = 0
+        if self.checkpoint_cfg and _serials(self.checkpoint_cfg.checkpoint_dir):
+            with scope_guard(self.scope):
+                meta = load_checkpoint(self.exe, self.checkpoint_cfg.checkpoint_dir, self.train_program)
+            self._epoch_start = meta.get("epoch", 0)
+            self._step_start = meta.get("step", 0)
+            self._serial_start = meta["serial"]
+
+    def stop(self):
+        self.__stopped = True
+
+    def train(self, num_epochs, event_handler=None, reader=None, feed_order=None):
+        event_handler = event_handler or (lambda e: None)
+        feeder = DataFeeder(
+            feed_list=[self.train_program.global_block().var(n) for n in feed_order],
+            place=self.place,
+            program=self.train_program,
+        )
+        self.__stopped = False
+        serial = self._serial_start
+        global_step = 0
+        with scope_guard(self.scope):
+            for epoch_id in range(self._epoch_start, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stopped:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = self.train_func_outputs if begin.fetch_metrics else []
+                    metrics = self.exe.run(
+                        self.train_program, feed=feeder.feed(data), fetch_list=fetch
+                    )
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    global_step += 1
+                    cfg = self.checkpoint_cfg
+                    if cfg and global_step % cfg.step_interval == 0:
+                        serial += 1
+                        save_checkpoint(
+                            self.exe, cfg.checkpoint_dir, self.train_program, serial,
+                            {"epoch": epoch_id, "step": step_id}, cfg.max_num_checkpoints,
+                        )
+                event_handler(EndEpochEvent(epoch_id))
+                cfg = self.checkpoint_cfg
+                if cfg and (epoch_id + 1) % cfg.epoch_interval == 0:
+                    serial += 1
+                    save_checkpoint(
+                        self.exe, cfg.checkpoint_dir, self.train_program, serial,
+                        {"epoch": epoch_id + 1, "step": 0}, cfg.max_num_checkpoints,
+                    )
+
+    def test(self, reader, feed_order):
+        feeder = DataFeeder(
+            feed_list=[self.test_program.global_block().var(n) for n in feed_order],
+            place=self.place,
+            program=self.test_program,
+        )
+        accumulated = None
+        count = 0
+        with scope_guard(self.scope):
+            for data in reader():
+                outs = self.exe.run(self.test_program, feed=feeder.feed(data),
+                                    fetch_list=self.train_func_outputs)
+                vals = [float(np.ravel(o)[0]) for o in outs]
+                accumulated = vals if accumulated is None else [a + v for a, v in zip(accumulated, vals)]
+                count += 1
+        return [a / max(count, 1) for a in (accumulated or [])]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            io_mod.save_persistables(self.exe, param_path, main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names, target_var_indexes):
+        with scope_guard(self.scope):
+            io_mod.save_inference_model(
+                param_path,
+                feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe,
+                main_program=self.train_program,
+            )
+
+
+class Inferencer:
+    """infer_func() -> prediction var(s); loads params from param_path
+    (reference: contrib/inferencer.py)."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        from .core import TPUPlace
+
+        self.place = place if place is not None else TPUPlace()
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.inference_program = Program()
+        with unique_name.guard():
+            with program_guard(self.inference_program, self.startup_program):
+                outs = infer_func()
+                self.predict_vars = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            io_mod.load_persistables(self.exe, param_path, main_program=self.inference_program)
+
+    def infer(self, inputs):
+        with scope_guard(self.scope):
+            results = self.exe.run(
+                self.inference_program, feed=inputs, fetch_list=self.predict_vars
+            )
+        return results
+
+
+# ---------------------------------------------------------------------------
+# failure detection (reference analog: the cluster heartbeat that
+# go/master & pserver use to detect dead trainers)
+# ---------------------------------------------------------------------------
+
+
+class Heartbeat:
+    """Background thread touching ``<dir>/<trainer_id>.hb`` with a timestamp
+    every ``interval`` seconds; a supervisor calls detect_failed_trainers."""
+
+    def __init__(self, dirname, trainer_id, interval=1.0):
+        self.path = os.path.join(dirname, "%s.hb" % trainer_id)
+        os.makedirs(dirname, exist_ok=True)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        while not self._stop.is_set():
+            with open(self.path, "w") as f:
+                f.write("%f" % time.time())
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def detect_failed_trainers(dirname, timeout):
+    """Trainer ids whose heartbeat file is older than ``timeout`` seconds."""
+    failed = []
+    now = time.time()
+    if not os.path.isdir(dirname):
+        return failed
+    for n in sorted(os.listdir(dirname)):
+        if not n.endswith(".hb"):
+            continue
+        try:
+            with open(os.path.join(dirname, n)) as f:
+                last = float(f.read().strip() or 0)
+        except (OSError, ValueError):
+            last = 0.0
+        if now - last > timeout:
+            failed.append(n[:-3])
+    return failed
