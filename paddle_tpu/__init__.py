@@ -34,6 +34,8 @@ from . import lod as lod_tensor_mod
 from . import dataset
 from . import transpiler
 from . import parallel
+from . import contrib
+from . import debugger
 from . import trainer as trainer_mod
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent, save_checkpoint, load_checkpoint)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, InferenceTranspiler, memory_optimize, release_memory

@@ -1,0 +1,42 @@
+"""Estimate a Program's memory footprint (reference:
+python/paddle/fluid/contrib/memory_usage_calc.py).
+
+Sums variable sizes (batch dim filled with ``batch_size``); returns
+(lower, upper, unit).  The reference's 70%–150% band reflected allocator
+slack; under XLA, buffer reuse usually lands *below* the raw sum, so the
+band here is [0.5×, 1.2×] of the summed size — still an estimate, the
+authoritative number is the compiled executable's memory analysis
+(``Executor`` stats / jax .memory_analysis()).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import np_dtype
+
+__all__ = ["memory_usage"]
+
+DTYPE_SIZES = {"float64": 8, "float32": 4, "bfloat16": 2, "float16": 2,
+               "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1, "bool": 1}
+
+
+def memory_usage(program, batch_size):
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive, got %r" % (batch_size,))
+    total = 0.0
+    for var in program.list_vars():
+        if var.shape is None:
+            continue
+        cnt = 1
+        for s in var.shape:
+            cnt *= batch_size if (s is None or s < 0) else s
+        try:
+            width = DTYPE_SIZES.get(str(var.dtype), np.dtype(np_dtype(var.dtype)).itemsize)
+        except TypeError:
+            width = 4
+        total += cnt * width
+
+    low, high = total * 0.5, total * 1.2
+    for unit, factor in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10), ("B", 1)):
+        if high >= factor or factor == 1:
+            return low / factor, high / factor, unit

@@ -1,0 +1,8 @@
+"""Quantization (reference: python/paddle/fluid/contrib/quantize/)."""
+from .quantize_transpiler import (  # noqa: F401
+    QuantizeTranspiler,
+    quantize_weight_abs_max,
+    dequantize_weight_abs_max,
+)
+
+__all__ = ["QuantizeTranspiler", "quantize_weight_abs_max", "dequantize_weight_abs_max"]

@@ -1,0 +1,114 @@
+"""Quantization transpiler (reference:
+python/paddle/fluid/contrib/quantize/quantize_transpiler.py).
+
+Two pieces:
+- host-side int8 weight quant/dequant helpers (abs-max, per-tensor or
+  per-output-channel) for post-training weight compression;
+- ``QuantizeTranspiler.training_transpile``: rewrites every conv2d /
+  depthwise_conv2d / mul in a Program to read its weight through a
+  ``fake_quantize_abs_max`` op — quantize-aware training with a
+  straight-through estimator (the op lowering keeps the rounding in the
+  forward and passes gradients through; see ops/nn_ops analog in
+  struct_ops pattern), all fused by XLA into the training step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework import OpRole
+from ...registry import register
+
+__all__ = ["QuantizeTranspiler", "quantize_weight_abs_max", "dequantize_weight_abs_max"]
+
+
+def quantize_weight_abs_max(w, bits=8, per_channel_axis=None):
+    """float weights -> (int8 array, float scale(s)).  abs-max symmetric."""
+    w = np.asarray(w)
+    qmax = float(2 ** (bits - 1) - 1)
+    if per_channel_axis is None:
+        scale = np.maximum(np.abs(w).max(), 1e-8)
+        q = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+        return q, np.float32(scale)
+    axes = tuple(i for i in range(w.ndim) if i != per_channel_axis)
+    scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True), 1e-8)
+    q = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def dequantize_weight_abs_max(q, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    return (np.asarray(q, np.float32) / qmax) * scale
+
+
+@register("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, op):
+    """QAT fake-quant: quantize-dequantize in fwd, straight-through grad
+    (y = x + stop_grad(qdq(x) - x))."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    bits = int(op.attrs.get("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.abs(x).max(), 1e-8)
+    qdq = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) / qmax * scale
+    out = x + jax.lax.stop_gradient(qdq - x)
+    ctx.set_output(op, "Out", out)
+    if "OutScale" in op.outputs:
+        ctx.set_output(op, "OutScale", scale.reshape(1))
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max", weight_quantize_type="abs_max"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    QUANTIZABLE = {"conv2d": "Filter", "depthwise_conv2d": "Filter", "mul": "Y"}
+
+    def training_transpile(self, program, startup_program=None):
+        """Insert fake-quant on the weight input of every quantizable op."""
+        blk = program.global_block()
+        new_ops = []
+        quantized = {}  # weight name -> fake-quant output var name
+        from ... import unique_name
+
+        for op in blk.ops:
+            slot = self.QUANTIZABLE.get(op.type)
+            if slot and op.attrs.get("op_role") not in (OpRole.Backward, OpRole.Optimize):
+                wname = op.inputs[slot][0]
+                if wname not in quantized:
+                    wvar = blk.vars[wname]
+                    qname = unique_name.generate(wname + ".quantized")
+                    blk.create_var(name=qname, shape=wvar.shape, dtype=wvar.dtype)
+                    sname = unique_name.generate(wname + ".scale")
+                    blk.create_var(name=sname, shape=[1], dtype="float32")
+                    attrs = {"bit_length": self.weight_bits}
+                    if op.attrs.get("op_role") is not None:
+                        attrs["op_role"] = op.attrs["op_role"]
+                    qop = type(op)(
+                        blk,
+                        "fake_quantize_abs_max",
+                        {"X": [wname]},
+                        {"Out": [qname], "OutScale": [sname]},
+                        attrs,
+                    )
+                    new_ops.append(qop)
+                    quantized[wname] = qname
+                op.inputs[slot] = [quantized[wname]]
+            new_ops.append(op)
+        blk.ops = new_ops
+        program._bump()
+        return program
+
+    def freeze_program(self, program, scope, place=None):
+        """Post-training: bake quantized weights back into the scope (the
+        int8 pair is what save_inference_model would export)."""
+        blk = program.global_block()
+        for op in blk.ops:
+            if op.type == "fake_quantize_abs_max":
+                wname = op.inputs["X"][0]
+                w = np.asarray(scope.vars[wname])
+                q, s = quantize_weight_abs_max(w, self.weight_bits)
+                scope.vars[wname] = dequantize_weight_abs_max(q, s, self.weight_bits).astype(w.dtype)
+        return program

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import time
 import warnings
 
 import numpy as np
@@ -453,7 +454,17 @@ class Executor:
             if use_program_cache:
                 self._cache[sig] = entry
 
-        fetches, new_state, new_key = entry(state_in, feed_arrays, key)
+        from . import profiler as _prof
+
+        if _prof.is_profiling():
+            import jax
+
+            t0 = time.perf_counter()
+            fetches, new_state, new_key = entry(state_in, feed_arrays, key)
+            jax.block_until_ready(fetches)
+            _prof.record("executor.run[prog@%x v%d]" % (id(program), program.version), time.perf_counter() - t0)
+        else:
+            fetches, new_state, new_key = entry(state_in, feed_arrays, key)
         scope.vars.update(new_state)
         scope.vars["__rng_key__"] = new_key
         if return_numpy:

@@ -13,7 +13,7 @@ import os
 import time
 from collections import defaultdict
 
-__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event"]
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event", "is_profiling", "record"]
 
 _timings = defaultdict(list)
 _active = {"on": False, "dir": None, "t0": None}
@@ -74,6 +74,10 @@ def record_event(name):
         yield
     finally:
         _timings[name].append(time.time() - t0)
+
+
+def is_profiling():
+    return _active["on"]
 
 
 def record(name, seconds):

@@ -1,0 +1,86 @@
+"""Aux subsystems: debugger graphviz, memory usage estimate, quantization
+(weight int8 + QAT transpile), profiler report (mirrors reference
+test_debugger / test_memory_usage / test_quantize_transpiler)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _mlp_program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        p = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_draw_block_graphviz(tmp_path):
+    main, _, _ = _mlp_program()
+    path = str(tmp_path / "g.dot")
+    fluid.debugger.draw_block_graphviz(main.global_block(), path=path)
+    src = open(path).read()
+    assert src.startswith("digraph") and "mul" in src and "->" in src
+    txt = fluid.debugger.repr_program(main)
+    assert "cross_entropy" in txt
+
+
+def test_memory_usage():
+    main, _, _ = _mlp_program()
+    low, high, unit = fluid.contrib.memory_usage(main, batch_size=32)
+    assert low > 0 and high > low and unit in ("B", "KB", "MB", "GB")
+
+
+def test_weight_quant_roundtrip():
+    rng = np.random.RandomState(0)
+    w = rng.randn(32, 16).astype("float32")
+    q, s = fluid.contrib.quantize.quantize_weight_abs_max(w)
+    assert q.dtype == np.int8
+    deq = fluid.contrib.quantize.dequantize_weight_abs_max(q, s)
+    assert np.abs(deq - w).max() < np.abs(w).max() / 100  # 8-bit error bound
+
+    qc, sc = fluid.contrib.quantize.quantize_weight_abs_max(w, per_channel_axis=1)
+    deqc = fluid.contrib.quantize.dequantize_weight_abs_max(qc, sc)
+    assert np.abs(deqc - w).max() <= np.abs(deq - w).max() + 1e-6
+
+
+def test_qat_transpile_trains():
+    main, startup, loss = _mlp_program()
+    t = fluid.contrib.quantize.QuantizeTranspiler()
+    t.training_transpile(main)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fake_quantize_abs_max") == 2  # one per fc weight
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype("float32")
+    y = rng.randint(0, 4, size=(64, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        assert losses[-1] < losses[0], losses
+        t.freeze_program(main, fluid.global_scope())
+
+
+def test_profiler_report(tmp_path, capsys):
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype("float32")
+    y = rng.randint(0, 4, size=(8, 1)).astype("int64")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with fluid.profiler.profiler("All"):
+            for _ in range(3):
+                exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    out = capsys.readouterr().out
+    assert "executor.run" in out and "Total(s)" in out
