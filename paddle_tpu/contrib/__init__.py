@@ -1,5 +1,6 @@
 """Contrib utilities (reference: python/paddle/fluid/contrib/)."""
 from .memory_usage_calc import memory_usage  # noqa: F401
 from . import quantize  # noqa: F401
+from . import mixed_precision  # noqa: F401
 
-__all__ = ["memory_usage", "quantize"]
+__all__ = ["memory_usage", "quantize", "mixed_precision"]

@@ -1,0 +1,98 @@
+"""Pipeline-style microbatching: gradient accumulation as a ``lax.scan``.
+
+Reference analog: none — the reference fits the batch or fails; gradient
+accumulation appeared in later Paddle versions.  On TPU this is the
+standard memory lever (SURVEY §2.4): split the global batch into k
+microbatches, scan the fwd+bwd over them accumulating parameter grads (one
+compiled loop body — activation memory is one microbatch's), then apply
+the optimizer ops once on the averaged grads.  Persistable side state (BN
+running stats, step counters) threads sequentially through the scan, so
+semantics match running the microbatches one after another.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..executor import LoweringContext, interpret_ops
+from ..framework import Program, Variable, grad_var_name
+
+__all__ = ["program_to_microbatched_fn"]
+
+
+def program_to_microbatched_fn(program: Program, fetch_list, num_microbatches: int):
+    """Build ``fn(state, feeds, key) -> (fetches, new_state)``.
+
+    Feeds' leading (batch) dim must divide by ``num_microbatches``.  Fetches
+    are stacked per microbatch on a new leading axis (average scalar losses
+    over it).  Equivalent to the plain executor step whenever the loss is a
+    batch mean (mean-of-means == full mean).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in fetch_list]
+    persistable = {v.name for v in program.list_vars() if v.persistable}
+
+    block = program.global_block()
+    bw_idx = next((i for i, op in enumerate(block.ops) if op.type == "backward"), None)
+    if bw_idx is None:
+        raise ValueError("program has no backward op — nothing to accumulate")
+    pre, bop, post = block.ops[:bw_idx], block.ops[bw_idx], block.ops[bw_idx + 1:]
+    loss_name = bop.inputs["Loss"][0]
+    no_grad = set(bop.attrs.get("no_grad_set") or ())
+    param_names = [p for p in bop.attrs["parameter_list"] if p not in no_grad]
+
+    def fn(state, feeds, rng_key=None):
+        key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+        k = num_microbatches
+        sliced = {}
+        for name, v in feeds.items():
+            v = jnp.asarray(v)
+            if v.shape[0] % k != 0:
+                raise ValueError(
+                    "feed %r batch %d not divisible by %d microbatches" % (name, v.shape[0], k)
+                )
+            sliced[name] = v.reshape((k, v.shape[0] // k) + v.shape[1:])
+
+        p0 = {p: state[p] for p in param_names}
+        aux0 = {n: v for n, v in state.items() if n in persistable and n not in p0}
+
+        def mb(carry, it):
+            grads_acc, aux = carry
+            feed_slice, mb_key = it
+
+            def fwd(param_vals):
+                env = {}
+                env.update(aux)
+                env.update(param_vals)
+                env.update(feed_slice)
+                ctx = LoweringContext(program, env, mb_key)
+                interpret_ops(ctx, pre)
+                loss = jnp.sum(env[loss_name].astype(jnp.float32))
+                return loss, env
+
+            (loss, env_after), grads = jax.value_and_grad(fwd, has_aux=True)(p0)
+            del loss
+            new_aux = {n: env_after[n] for n in aux}
+            fetches = [env_after[n] for n in fetch_names]
+            grads_acc = jax.tree_util.tree_map(lambda a, g: a + g, grads_acc, grads)
+            return (grads_acc, new_aux), fetches
+
+        g0 = {p: jnp.zeros(jnp.shape(v), jnp.result_type(v, jnp.float32)) for p, v in p0.items()}
+        keys = jax.random.split(key, k)
+        (grads, aux_last), fetches = jax.lax.scan(mb, (g0, aux0), (sliced, keys))
+
+        # optimizer ops once, on averaged grads
+        env = {}
+        env.update(aux_last)
+        env.update(p0)
+        for p in param_names:
+            env[grad_var_name(p)] = (grads[p] / k).astype(jnp.result_type(state[p]))
+        ctx = LoweringContext(program, env, key)
+        interpret_ops(ctx, post)
+        new_state = {n: v for n, v in env.items() if n in persistable}
+        for n in state:
+            new_state.setdefault(n, env.get(n, state[n]))
+        return fetches, new_state
+
+    return fn

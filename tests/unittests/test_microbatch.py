@@ -1,0 +1,59 @@
+"""Gradient-accumulation microbatching equals the full-batch step when the
+loss is a batch mean; memory-bound pipeline lever (SURVEY 2.4)."""
+import numpy as np
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.jax_bridge import init_state, program_to_fn
+from paddle_tpu.parallel.microbatch import program_to_microbatched_fn
+
+
+def _program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="tanh")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_microbatched_step_matches_full_batch():
+    main, startup, loss = _program()
+    rng = np.random.RandomState(0)
+    B = 32
+    feeds = {
+        "x": rng.randn(B, 6).astype("float32"),
+        "y": rng.randn(B, 1).astype("float32"),
+    }
+
+    state = init_state(startup)
+    full = program_to_fn(main, [loss], return_state=True)
+    (full_loss,), full_state = full(dict(state), feeds, jax.random.PRNGKey(1))
+
+    mb_fn = program_to_microbatched_fn(main, [loss], num_microbatches=4)
+    mb_losses, mb_state = mb_fn(dict(state), feeds, jax.random.PRNGKey(1))
+
+    np.testing.assert_allclose(
+        float(np.mean(np.asarray(mb_losses[0]))), float(np.ravel(full_loss)[0]), rtol=1e-5
+    )
+    for n in full_state:
+        np.testing.assert_allclose(
+            np.asarray(mb_state[n]), np.asarray(full_state[n]), rtol=1e-5, atol=1e-6,
+            err_msg=n,
+        )
+
+
+def test_microbatched_fn_jits():
+    main, startup, loss = _program()
+    state = init_state(startup)
+    mb_fn = jax.jit(program_to_microbatched_fn(main, [loss], num_microbatches=2))
+    rng = np.random.RandomState(1)
+    feeds = {"x": rng.randn(8, 6).astype("float32"), "y": rng.randn(8, 1).astype("float32")}
+    fetches, new_state = mb_fn(state, feeds, jax.random.PRNGKey(0))
+    assert np.isfinite(np.asarray(fetches[0])).all()

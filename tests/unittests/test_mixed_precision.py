@@ -1,0 +1,54 @@
+"""bf16 mixed precision: program rewrite puts matmuls/convs on bf16 with f32
+master weights; decorated optimizer trains; loss scaling round-trips."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_bf16_rewrite_and_train():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        p = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=p, label=y))
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(learning_rate=0.2), init_loss_scaling=8.0
+        )
+        opt.minimize(loss)
+
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    # params (master weights) stay f32
+    for p_ in main.global_block().all_parameters():
+        assert str(p_.dtype) == "float32", (p_.name, p_.dtype)
+    # mul ops now read bf16 inputs
+    mul_ops = [op for op in main.global_block().ops
+               if op.type == "mul" and op.attrs.get("op_role") not in ("backward", "optimize")]
+    for op in mul_ops:
+        xvar = main.global_block().vars[op.inputs["X"][0]]
+        assert str(xvar.dtype) == "bfloat16", (op.inputs, xvar.dtype)
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8).astype("float32")
+    ys = rng.randint(0, 4, size=(64, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_dynamic_loss_scaling_rejected():
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(learning_rate=0.1), use_dynamic_loss_scaling=True
+        )
