@@ -201,20 +201,43 @@ class EditDistance(MetricBase):
 
 
 class DetectionMAP(MetricBase):
-    def __init__(self, name=None):
-        super().__init__(name)
-        self.has_state = None
+    """Accumulates detection_output results + padded ground truth across
+    batches; eval() computes mAP (compute_detection_map below — the
+    host-side analog of the reference's detection_map op)."""
 
-    def update(self, value, weight=None):
-        if not _is_number_or_matrix_(np.asarray(value)):
-            raise ValueError("value must be a number or ndarray")
-        self.value = float(np.asarray(value).reshape(-1)[0])
-        self.has_state = True
+    def __init__(self, name=None, num_classes=None, overlap_threshold=0.5,
+                 ap_version="integral", background=0):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.background = background
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self._dets, self._boxes, self._labels, self._lens = [], [], [], []
+
+    def update(self, detections, gt_boxes=None, gt_labels=None, gt_lens=None):
+        if gt_boxes is None:
+            # reference compat: a precomputed scalar mAP value
+            self._dets.append(float(np.asarray(detections).reshape(-1)[0]))
+            return
+        self._dets.append(np.asarray(detections))
+        self._boxes.append(np.asarray(gt_boxes))
+        self._labels.append(np.asarray(gt_labels))
+        self._lens.append(np.asarray(gt_lens))
 
     def eval(self):
-        if self.has_state is None:
+        if not self._dets:
             raise ValueError("no data accumulated")
-        return self.value
+        if not self._boxes:  # scalar mode
+            return float(np.mean(self._dets))
+        maps = [
+            compute_detection_map(d, b, l, n, self.num_classes,
+                                  self.overlap_threshold, self.ap_version, self.background)
+            for d, b, l, n in zip(self._dets, self._boxes, self._labels, self._lens)
+        ]
+        return float(np.mean(maps))
 
 
 class Auc(MetricBase):
@@ -256,3 +279,73 @@ class Auc(MetricBase):
             auc += self.trapezoid_area(tot_neg, tot_neg_prev, tot_pos, tot_pos_prev)
             idx -= 1
         return auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 else 0.0
+
+
+def compute_detection_map(detections, gt_boxes, gt_labels, gt_lens, num_classes,
+                          overlap_threshold=0.5, ap_version="integral", background=0):
+    """mAP over one evaluation pass (reference analog:
+    operators/detection_map_op.h, computed host-side on fetched arrays).
+
+    detections: ``detection_output`` result, [B, K, 6] rows
+    (label, score, x0, y0, x1, y1), invalid rows -1.
+    gt_boxes [B, G, 4], gt_labels [B, G], gt_lens [B].
+    ap_version: 'integral' (VOC2010 every-point) or '11point'.
+    """
+    detections = np.asarray(detections)
+    gt_boxes = np.asarray(gt_boxes)
+    gt_labels = np.asarray(gt_labels)
+    gt_lens = np.asarray(gt_lens).astype(int)
+
+    def iou(a, b):
+        ix = max(min(a[2], b[2]) - max(a[0], b[0]), 0.0)
+        iy = max(min(a[3], b[3]) - max(a[1], b[1]), 0.0)
+        inter = ix * iy
+        ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for c in range(num_classes):
+        if c == background:
+            continue
+        npos = sum(int((gt_labels[b, : gt_lens[b]] == c).sum()) for b in range(len(gt_lens)))
+        scored = []  # (score, batch, box)
+        for b in range(detections.shape[0]):
+            for row in detections[b]:
+                if row[0] == c:
+                    scored.append((float(row[1]), b, row[2:6]))
+        if npos == 0:
+            continue
+        scored.sort(key=lambda t: -t[0])
+        matched = [np.zeros(gt_lens[b], bool) for b in range(len(gt_lens))]
+        tp = np.zeros(len(scored))
+        fp = np.zeros(len(scored))
+        for i, (score, b, box) in enumerate(scored):
+            best, best_j = 0.0, -1
+            for j in range(gt_lens[b]):
+                if gt_labels[b, j] != c:
+                    continue
+                ov = iou(box, gt_boxes[b, j])
+                if ov > best:
+                    best, best_j = ov, j
+            if best >= overlap_threshold and best_j >= 0 and not matched[b][best_j]:
+                matched[b][best_j] = True
+                tp[i] = 1
+            else:
+                fp[i] = 1
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        recall = ctp / npos
+        precision = ctp / np.maximum(ctp + cfp, 1e-12)
+        if ap_version == "11point":
+            ap = float(np.mean([
+                (precision[recall >= t].max() if (recall >= t).any() else 0.0)
+                for t in np.linspace(0, 1, 11)
+            ]))
+        else:
+            mrec = np.concatenate([[0.0], recall, [1.0]])
+            mpre = np.concatenate([[0.0], precision, [0.0]])
+            for i in range(len(mpre) - 2, -1, -1):
+                mpre[i] = max(mpre[i], mpre[i + 1])
+            idx = np.where(mrec[1:] != mrec[:-1])[0]
+            ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0

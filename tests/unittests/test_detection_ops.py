@@ -163,3 +163,22 @@ def test_nms_suppresses_overlaps():
     assert len(kept) == 2, out
     np.testing.assert_allclose(kept[0, 2:], prior[0], atol=1e-5)
     np.testing.assert_allclose(kept[1, 2:], prior[2], atol=1e-5)
+
+
+def test_detection_map_metric():
+    from paddle_tpu import metrics
+
+    # one image, two gt of class 1; detections: one perfect hit, one miss
+    gt_boxes = np.array([[[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8]]], "float32")
+    gt_labels = np.array([[1, 1]], "int64")
+    gt_lens = np.array([2])
+    dets = np.full((1, 3, 6), -1.0, "float32")
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.3, 0.3]   # TP
+    dets[0, 1] = [1, 0.8, 0.0, 0.6, 0.1, 0.9]   # FP
+    m = metrics.compute_detection_map(dets, gt_boxes, gt_labels, gt_lens, num_classes=3)
+    # precision at recall .5 = 1.0, no further recall: integral AP = 0.5
+    np.testing.assert_allclose(m, 0.5, atol=1e-6)
+
+    dm = metrics.DetectionMAP(num_classes=3)
+    dm.update(dets, gt_boxes, gt_labels, gt_lens)
+    np.testing.assert_allclose(dm.eval(), 0.5, atol=1e-6)
