@@ -2,5 +2,6 @@
 from .memory_usage_calc import memory_usage  # noqa: F401
 from . import quantize  # noqa: F401
 from . import mixed_precision  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
 
-__all__ = ["memory_usage", "quantize", "mixed_precision"]
+__all__ = ["memory_usage", "quantize", "mixed_precision", "op_freq_statistic"]

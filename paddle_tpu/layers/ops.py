@@ -22,6 +22,7 @@ _ACT_NOATTR = [
     "square",
     "sqrt",
     "rsqrt",
+    "selu",
     "sign",
 ]
 
