@@ -194,10 +194,11 @@ _ACTS = {
     "sqrt": lambda x, a: _jnp().sqrt(x),
     "rsqrt": lambda x, a: 1.0 / _jnp().sqrt(x),
     "log": lambda x, a: _jnp().log(x),
+    # scale * elu(x, alpha) — via jax.nn's overflow-safe formulation (a naive
+    # where(x>0, ...) NaNs the grad once exp(x) overflows under value_and_grad)
     "selu": lambda x, a: (
-        a.get("scale", 1.0507009873554805) * _jnp().where(
-            x > 0, x, a.get("alpha", 1.6732632423543772) * (_jnp().exp(x) - 1)
-        )
+        a.get("scale", 1.0507009873554805)
+        * _jn().elu(x, a.get("alpha", 1.6732632423543772))
     ),
     "pow": lambda x, a: x ** a.get("factor", 1.0),
 }

@@ -84,3 +84,44 @@ def test_profiler_report(tmp_path, capsys):
                 exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
     out = capsys.readouterr().out
     assert "executor.run" in out and "Total(s)" in out
+
+
+def test_selu_values_and_overflow_safe_grad():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act=None)
+        y = fluid.layers.selu(h)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # large inputs would overflow exp() in a naive selu grad
+    xs = np.array([[-1.0, 0.0, 1.0, 200.0]], "float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        assert np.isfinite(float(np.ravel(lv)[0]))
+        for n, v in fluid.global_scope().vars.items():
+            if n.endswith("w_0"):
+                assert np.isfinite(np.asarray(v)).all(), n
+
+    # value check vs the canonical constants
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    t = np.array([-1.0, 0.0, 2.0], "float32")
+    m2 = fluid.Program()
+    with fluid.program_guard(m2, fluid.Program()):
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        out = fluid.layers.selu(xv)
+    with fluid.scope_guard(fluid.Scope()):
+        (o,) = exe.run(m2, feed={"x": t[None]}, fetch_list=[out])
+    expected = scale * np.where(t > 0, t, alpha * np.expm1(t))
+    np.testing.assert_allclose(o[0], expected, rtol=1e-6)
+
+
+def test_op_freq_statistic():
+    main, _, _ = _mlp_program()
+    single, pair = fluid.contrib.op_freq_statistic(main)
+    assert single["mul"] >= 2 and "softmax" in single
+    assert any("mul->" in k for k in pair)
+    assert list(single.values()) == sorted(single.values(), reverse=True)
