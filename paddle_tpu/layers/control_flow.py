@@ -23,6 +23,10 @@ __all__ = [
     "create_array",
     "less_than",
     "equal",
+    "not_equal",
+    "greater_than",
+    "greater_equal",
+    "less_equal",
     "array_read",
     "array_length",
     "IfElse",
@@ -86,6 +90,31 @@ def equal(x, y, cond=None, **ignored):
         cond.stop_gradient = True
     helper.append_op(type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
     return cond
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool", shape=x.shape)
+        cond.stop_gradient = True
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
+    return cond
+
+
+def not_equal(x, y, cond=None, **ignored):
+    return _compare("not_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None, **ignored):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None, **ignored):
+    return _compare("greater_equal", x, y, cond)
+
+
+def less_equal(x, y, cond=None, **ignored):
+    return _compare("less_equal", x, y, cond)
 
 
 def is_empty(x, cond=None, **ignored):

@@ -298,7 +298,15 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, gro
     dilation_ = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 3
     filter_shape = [num_filters, num_channels // groups] + list(fsize)
     w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape, dtype=dtype)
-    pre_bias = helper.create_variable_for_type_inference(dtype)
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 5:
+        spatial = input.shape[2:]
+        if all(s and s > 0 for s in spatial):
+            out_shape = [input.shape[0], num_filters] + [
+                (s + 2 * padding_[i] - dilation_[i] * (fsize[i] - 1) - 1) // stride_[i] + 1
+                for i, s in enumerate(spatial)
+            ]
+    pre_bias = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     helper.append_op(
         type="conv3d",
         inputs={"Input": [input], "Filter": [w]},
@@ -509,7 +517,14 @@ def conv2d_transpose(
     fsize = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
     filter_shape = [in_c, num_filters // groups] + list(fsize)
     w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape, dtype=dtype)
-    pre_bias = helper.create_variable_for_type_inference(dtype)
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 4:
+        h, w_in = input.shape[2], input.shape[3]
+        if h and h > 0 and w_in and w_in > 0:
+            oh = (h - 1) * stride_[0] - 2 * padding_[0] + dilation_[0] * (fsize[0] - 1) + 1
+            ow = (w_in - 1) * stride_[1] - 2 * padding_[1] + dilation_[1] * (fsize[1] - 1) + 1
+            out_shape = [input.shape[0], num_filters, oh, ow]
+    pre_bias = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     helper.append_op(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [w]},
@@ -529,7 +544,14 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None, pad
     padding_ = padding if isinstance(padding, (list, tuple)) else [padding] * 3
     filter_shape = [in_c, num_filters] + list(fsize)
     w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape, dtype=dtype)
-    pre_bias = helper.create_variable_for_type_inference(dtype)
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 5:
+        spatial = input.shape[2:]
+        if all(s and s > 0 for s in spatial):
+            out_shape = [input.shape[0], num_filters] + [
+                (s - 1) * stride_[i] - 2 * padding_[i] + fsize[i] for i, s in enumerate(spatial)
+            ]
+    pre_bias = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     helper.append_op(
         type="conv3d_transpose",
         inputs={"Input": [input], "Filter": [w]},
@@ -1041,7 +1063,11 @@ def flatten(x, axis=1, name=None):
 def stack(x, axis=0):
     helper = LayerHelper("stack")
     x = x if isinstance(x, (list, tuple)) else [x]
-    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    shape = None
+    if x[0].shape is not None:
+        shape = list(x[0].shape)
+        shape.insert(axis if axis >= 0 else axis + len(shape) + 1, len(x))
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype, shape=shape)
     helper.append_op(type="stack", inputs={"X": x}, outputs={"Y": [out]}, attrs={"axis": axis})
     return out
 

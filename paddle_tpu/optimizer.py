@@ -475,6 +475,13 @@ class ModelAverage(Optimizer):
         self.max_average_window = max_average_window
         self.params_grads = []
         self._registered = False
+        # reference semantics: constructing ModelAverage inside the program
+        # context (after the real optimizer's minimize) registers the
+        # accumulator ops immediately
+        try:
+            self._register()
+        except Exception:
+            pass  # no trainable params yet; caller may _register() later
 
     def _register(self, program=None):
         program = program or default_main_program()

@@ -13,7 +13,7 @@ class ParamAttr:
         regularizer=None,
         trainable=True,
         gradient_clip=None,
-        do_model_average=False,
+        do_model_average=None,  # None -> averaged (reference: the model_average/do_model_average kwarg mismatch makes every param average-eligible by default)
     ):
         self.name = name
         self.initializer = initializer

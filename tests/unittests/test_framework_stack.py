@@ -1,0 +1,264 @@
+"""Framework-stack tests: every optimizer converges, initializers have the
+right statistics, LR schedulers produce the reference curves, clipping and
+regularization act on gradients, metrics accumulate, reader decorators
+compose (mirrors reference test_optimizer / test_initializer /
+test_learning_rate_scheduler / test_gradient_clip / test_regularizer /
+test_metrics / reader decorator tests)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+OPTIMIZERS = [
+    ("SGD", lambda: fluid.optimizer.SGD(learning_rate=0.1)),
+    ("Momentum", lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)),
+    ("MomentumNesterov", lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9, use_nesterov=True)),
+    ("Adagrad", lambda: fluid.optimizer.Adagrad(learning_rate=0.3)),
+    ("Adam", lambda: fluid.optimizer.Adam(learning_rate=0.1)),
+    ("Adamax", lambda: fluid.optimizer.Adamax(learning_rate=0.1)),
+    ("DecayedAdagrad", lambda: fluid.optimizer.DecayedAdagrad(learning_rate=0.3)),
+    ("Adadelta", lambda: fluid.optimizer.Adadelta(learning_rate=1.0, epsilon=1e-2)),
+    ("RMSProp", lambda: fluid.optimizer.RMSProp(learning_rate=0.05)),
+    ("Ftrl", lambda: fluid.optimizer.Ftrl(learning_rate=0.5)),
+]
+
+
+@pytest.mark.parametrize("name,make", OPTIMIZERS)
+def test_optimizer_converges_on_quadratic(name, make):
+    """Minimize ||Wx - y||² — every optimizer must fit the toy quadratic."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+        make().minimize(loss)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype("float32")
+    Y = (X @ np.array([[1.0], [-1.0], [2.0], [0.3]], "float32")).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.3, (name, losses[0], losses[-1])
+
+
+def test_model_average_applies_and_restores():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, param_attr=fluid.ParamAttr(name="w"), bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(average_window_rate=0.5, min_average_window=1, max_average_window=8)
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 2).astype("float32")
+    Y = (X @ np.array([[2.0], [-1.0]], "float32")).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(10):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        w_trained = np.asarray(fluid.global_scope()["w"]).copy()
+        with ma.apply(exe):
+            w_avg = np.asarray(fluid.global_scope()["w"]).copy()
+        w_restored = np.asarray(fluid.global_scope()["w"])
+    assert not np.allclose(w_avg, w_trained)
+    np.testing.assert_allclose(w_restored, w_trained)
+
+
+# ---------------------------------------------------------------------------
+# initializers (statistical)
+# ---------------------------------------------------------------------------
+
+
+def _init_param(initializer, shape=(400, 300)):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.layers.create_parameter(shape=list(shape), dtype="float32", name="p",
+                                      attr=fluid.ParamAttr(name="p", initializer=initializer))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return np.asarray(fluid.global_scope()["p"])
+
+
+def test_initializers_statistics():
+    v = _init_param(fluid.initializer.Constant(0.25))
+    assert np.all(v == 0.25)
+
+    v = _init_param(fluid.initializer.Uniform(low=-2, high=2))
+    assert -2 <= v.min() and v.max() <= 2 and abs(v.mean()) < 0.05
+
+    v = _init_param(fluid.initializer.Normal(loc=1.0, scale=2.0))
+    assert abs(v.mean() - 1.0) < 0.05 and abs(v.std() - 2.0) < 0.05
+
+    v = _init_param(fluid.initializer.TruncatedNormal(loc=0.0, scale=1.0))
+    assert np.abs(v).max() <= 2.0 + 1e-5  # truncated at 2 sigma
+
+    fan_in, fan_out = 400, 300
+    v = _init_param(fluid.initializer.Xavier())  # uniform variant
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    assert v.max() <= limit + 1e-6 and abs(v.std() - limit / math.sqrt(3)) < 0.01
+
+    v = _init_param(fluid.initializer.MSRA())
+    limit = math.sqrt(6.0 / fan_in)
+    assert v.max() <= limit + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedulers
+# ---------------------------------------------------------------------------
+
+
+def _run_scheduler(build_lr, steps=5):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build_lr()
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            (v,) = exe.run(main, feed={}, fetch_list=[lr])
+            out.append(float(np.ravel(v)[0]))
+    return out
+
+
+def test_lr_schedulers():
+    vals = _run_scheduler(lambda: fluid.layers.exponential_decay(0.1, 1, 0.5, staircase=True))
+    np.testing.assert_allclose(vals[:4], [0.1, 0.05, 0.025, 0.0125], rtol=1e-5)
+
+    vals = _run_scheduler(lambda: fluid.layers.natural_exp_decay(0.1, 1, 1.0, staircase=True))
+    np.testing.assert_allclose(vals[1], 0.1 * np.exp(-1), rtol=1e-5)
+
+    vals = _run_scheduler(lambda: fluid.layers.inverse_time_decay(0.1, 1, 1.0, staircase=True))
+    np.testing.assert_allclose(vals[1], 0.1 / 2, rtol=1e-5)
+
+    vals = _run_scheduler(lambda: fluid.layers.polynomial_decay(0.1, 4, 0.01, power=1.0))
+    np.testing.assert_allclose(vals[2], 0.1 - (0.1 - 0.01) * 2 / 4, rtol=1e-5)
+
+    vals = _run_scheduler(lambda: fluid.layers.piecewise_decay([2, 4], [0.1, 0.01, 0.001]), steps=6)
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001], rtol=1e-5)
+
+    vals = _run_scheduler(lambda: fluid.layers.noam_decay(64, warmup_steps=3))
+    expected = [(64 ** -0.5) * min((s + 1) ** -0.5, (s + 1) * 3 ** -1.5) for s in range(5)]
+    np.testing.assert_allclose(vals, expected, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# clipping / regularization
+# ---------------------------------------------------------------------------
+
+
+def _grad_after(build_clip=None, regularizer=None):
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(
+            input=x, size=1, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="w", regularizer=regularizer),
+        )
+        loss = fluid.layers.mean(pred) * 100.0
+        if build_clip is not None:
+            fluid.clip.set_gradient_clip(build_clip())
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    X = np.ones((2, 4), "float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        w0 = np.asarray(fluid.global_scope()["w"]).copy()
+        exe.run(main, feed={"x": X}, fetch_list=[loss])
+        w1 = np.asarray(fluid.global_scope()["w"])
+    return w0, w1  # applied grad = w0 - w1 (lr=1)
+
+
+def test_gradient_clip_by_global_norm():
+    w0, w1 = _grad_after(lambda: fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+    applied = w0 - w1
+    np.testing.assert_allclose(np.linalg.norm(applied), 1.0, rtol=1e-4)
+
+
+def test_gradient_clip_by_value():
+    w0, w1 = _grad_after(lambda: fluid.clip.GradientClipByValue(max=0.1, min=-0.1))
+    applied = w0 - w1
+    assert np.abs(applied).max() <= 0.1 + 1e-6
+
+
+def test_l2_regularizer_changes_grad():
+    w0a, w1a = _grad_after()
+    w0b, w1b = _grad_after(regularizer=fluid.regularizer.L2Decay(0.5))
+    ga = w0a - w1a
+    gb = w0b - w1b
+    np.testing.assert_allclose(gb, ga + 0.5 * w0b, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# metrics + readers
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_accumulate():
+    m = fluid.metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=10)
+    np.testing.assert_allclose(m.eval(), 0.75)
+
+    p = fluid.metrics.Precision()
+    preds = np.array([[0.9], [0.2], [0.8]])
+    labels = np.array([[1], [0], [0]])
+    p.update(preds, labels)
+    np.testing.assert_allclose(p.eval(), 0.5)  # 1 TP / (1 TP + 1 FP)
+
+    e = fluid.metrics.EditDistance("ed")
+    e.update(np.array([[1.0], [0.0]]), seq_num=2)
+    avg, inst_err = e.eval()
+    np.testing.assert_allclose(avg, 0.5)
+
+
+def test_reader_decorators_compose():
+    from paddle_tpu import reader
+
+    def r():
+        return iter(range(10))
+
+    batched = fluid.batch(lambda: iter(range(10)), batch_size=3)
+    batches = list(batched())
+    assert batches[0] == [0, 1, 2] and len(batches) == 4  # last partial kept
+
+    shuffled = reader.decorator.shuffle(lambda: iter(range(10)), buf_size=10)
+    vals = list(shuffled())
+    assert sorted(vals) == list(range(10))
+
+    mapped = reader.decorator.map_readers(lambda a, b: a + b, lambda: iter([1, 2]), lambda: iter([10, 20]))
+    assert list(mapped()) == [11, 22]
+
+    chained = reader.decorator.chain(lambda: iter([1]), lambda: iter([2]))
+    assert list(chained()) == [1, 2]
+
+    composed = reader.decorator.compose(lambda: iter([1, 2]), lambda: iter([3, 4]))
+    assert list(composed()) == [(1, 3), (2, 4)]
+
+    first2 = reader.decorator.firstn(lambda: iter(range(100)), 2)
+    assert list(first2()) == [0, 1]
+
+    buffered = reader.decorator.buffered(lambda: iter(range(5)), size=2)
+    assert list(buffered()) == list(range(5))

