@@ -1,0 +1,60 @@
+"""Transformer beam-search inference: train a tiny copy task, then
+fast_decode reproduces the target (reference analog: transformer
+fast_decoder inference in the NMT benchmark)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer as T
+
+
+def test_transformer_fast_decode_copy_task():
+    V, L = 20, 8
+    dims = dict(src_vocab_size=V, trg_vocab_size=V, max_length=16,
+                n_layer=1, n_head=2, d_model=32, d_inner=64)
+
+    with fluid.unique_name.guard():
+        main = fluid.Program()
+        startup = fluid.Program()
+        startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            src = fluid.layers.data(name="src_word", shape=[L], dtype="int64")
+            trg = fluid.layers.data(name="trg_word", shape=[L], dtype="int64")
+            lbl = fluid.layers.data(name="lbl_word", shape=[L], dtype="int64")
+            avg, _, _, _ = T.transformer(src, trg, lbl, dropout=0.0,
+                                         label_smooth_eps=0.0, **dims)
+            fluid.optimizer.Adam(learning_rate=3e-3).minimize(avg)
+
+    with fluid.unique_name.guard():
+        inf = T.get_inference_model(beam_size=2, max_out_len=L, seq_len=L, **dims)
+
+    # copy task: target = source (shifted with BOS/EOS)
+    rng = np.random.RandomState(0)
+    B = 8
+    body = rng.randint(3, V, size=(B, L - 2)).astype("int64")
+    src_seq = np.concatenate([body, np.full((B, 2), T.PAD_IDX, "int64")], axis=1)
+    trg_in = np.concatenate([np.full((B, 1), T.BOS_IDX, "int64"), body,
+                             np.full((B, 1), T.PAD_IDX, "int64")], axis=1)
+    lbl_out = np.concatenate([body, np.full((B, 1), T.EOS_IDX, "int64"),
+                              np.full((B, 1), T.PAD_IDX, "int64")], axis=1)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(150):
+            (lv,) = exe.run(main, feed={"src_word": src_seq, "trg_word": trg_in,
+                                        "lbl_word": lbl_out}, fetch_list=[avg])
+            losses.append(float(np.ravel(lv)[0]))
+        assert losses[-1] < 0.2, (losses[0], losses[-1])
+
+        ids, scores = exe.run(inf["infer"], feed={"src_word": src_seq},
+                              fetch_list=[inf["ids"], inf["scores"]])
+    # ids: [B, beam, T]; best beam reproduces the source body then EOS
+    assert ids.shape[0] == B
+    best = ids[:, 0, :]
+    correct = 0
+    for b in range(B):
+        want = list(body[b]) + [T.EOS_IDX]
+        got = list(best[b, : len(want)])
+        correct += got == want
+    assert correct >= B - 1, (correct, best[:2], body[:2])
