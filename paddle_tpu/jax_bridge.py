@@ -46,3 +46,16 @@ def init_state(startup_program: Program, seed=0):
     lower_block(ctx, startup_program.global_block())
     persistable = {v.name for v in startup_program.list_vars() if v.persistable}
     return {n: v for n, v in env.items() if n in persistable}
+
+
+def aot_compile(program, fetch_list, state, example_feeds, is_test=True):
+    """AOT-compile a program for fixed feed shapes (reference analog: the
+    C++ inference engine pre-building its executable; SURVEY 2.6).  Returns
+    a compiled XLA executable: ``compiled(state, feeds) -> fetches`` with
+    zero retrace cost; raises on shape mismatch instead of recompiling."""
+    import jax
+
+    fn = program_to_fn(program, fetch_list, is_test=is_test)
+    lowered = jax.jit(fn).lower(state, example_feeds)
+    compiled = lowered.compile()
+    return compiled

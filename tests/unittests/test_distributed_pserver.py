@@ -155,3 +155,60 @@ def test_deepfm_trains():
             losses.append(float(np.ravel(lv)[0]))
         assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
         assert float(np.ravel(av)[0]) > 0.8
+
+
+def test_pserver_two_trainers_sync():
+    """Two trainers, one pserver: sync barrier sums both grads per round and
+    both trainers see identical fresh params."""
+    main, startup, cost = _build_program()
+    ep = "127.0.0.1:17120"
+    results = {}
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup, pservers=ep, trainers=2)
+    trainer_prog = t.get_trainer_program()
+    ps_prog = t.get_pserver_program(ep)
+    ps_startup = t.get_startup_program(ep, ps_prog, startup)
+
+    ps_scope = fluid.Scope()
+    ps_exe = fluid.Executor(fluid.CPUPlace())
+
+    def serve():
+        with fluid.scope_guard(ps_scope):
+            ps_exe.run(ps_startup, scope=ps_scope)
+            ps_exe.run(ps_prog, scope=ps_scope)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], "float32")
+    datasets = {}
+    for tid in range(2):
+        X = rng.randn(32, 4).astype("float32")
+        datasets[tid] = (X, X @ w_true)
+
+    def run_trainer(tid):
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        X, Y = datasets[tid]
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            for _ in range(40):
+                (lv,) = exe.run(trainer_prog, feed={"x": X, "y": Y}, fetch_list=[cost], scope=scope)
+            results[tid] = (float(np.ravel(lv)[0]), np.asarray(scope.vars["w"]).copy())
+        if tid == 0:
+            exe.close()  # one trainer shuts the server down at the end
+        else:
+            for c in getattr(exe, "_ps_clients", {}).values():
+                c.close()
+
+    t1 = threading.Thread(target=run_trainer, args=(1,))
+    t1.start()
+    run_trainer(0)
+    t1.join(timeout=60)
+    th.join(timeout=10)
+    assert not th.is_alive()
+    # both trainers converged on the shared params
+    np.testing.assert_allclose(results[0][1], results[1][1], atol=1e-5)
+    np.testing.assert_allclose(results[0][1], w_true, atol=0.3)

@@ -80,3 +80,27 @@ def test_inference_model_prunes_backward(tmp_path):
         prog, _, _ = fluid.io.load_inference_model(str(tmp_path / "m"), exe)
     types = {op.type for op in prog.global_block().ops}
     assert "sgd" not in types and "backward" not in types, types
+
+
+def test_aot_compiled_inference():
+    """jit(...).lower().compile() path: compiled executable matches exe.run
+    and refuses new shapes instead of silently retracing."""
+    import pytest
+
+    from paddle_tpu.jax_bridge import aot_compile, init_state
+
+    scope = fluid.Scope()
+    main, exe, pred, (xv, yv) = _build_and_train(scope)
+    infer = main.prune([pred])
+    state = {n: np.asarray(v) for n, v in scope.vars.items()
+             if n != "__rng_key__" and v is not None and not n.startswith("learning_rate")}
+    state = {v.name: state[v.name] for v in infer.list_vars() if v.persistable and v.name in state}
+
+    compiled = aot_compile(infer, [pred], state, {"x": xv})
+    (out,) = compiled(state, {"x": xv})
+    with fluid.scope_guard(scope):
+        (want,) = exe.run(infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+    with pytest.raises(Exception):
+        compiled(state, {"x": xv[:3]})  # different batch: no silent retrace
