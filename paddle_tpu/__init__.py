@@ -55,7 +55,7 @@ from .executor import Executor, Scope, global_scope, scope_guard
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
-from .lod import LoDArray, create_lod_array
+from .lod import LoDArray, create_lod_array, create_lod_tensor, create_random_int_lodtensor
 from .evaluator import Evaluator
 
 create_lod_tensor = create_lod_array

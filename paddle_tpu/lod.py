@@ -87,3 +87,20 @@ def create_lod_array(data, recursive_seq_lens=None, place=None) -> LoDArray:
         seqs = [data[offs[i]: offs[i + 1]] for i in range(len(lens))]
         return pack_sequences(seqs)
     raise NotImplementedError("nested lod>1 flat construction; pass per-item lists instead")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Reference-spelling alias (python/paddle/fluid/lod_tensor.py:23):
+    build the padded+lengths LoDArray from data + per-sequence lengths."""
+    return create_lod_array(data, recursive_seq_lens, place)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None, low=0, high=10):
+    """Random int LoD tensor (reference lod_tensor.py:74): one sequence per
+    entry of the last-level lengths, values in [low, high]."""
+    lens = list(recursive_seq_lens[-1])
+    seqs = [
+        np.random.randint(low, high + 1, size=[L] + list(base_shape)).astype("int64")
+        for L in lens
+    ]
+    return pack_sequences(seqs)
