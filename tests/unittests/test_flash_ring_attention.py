@@ -65,14 +65,14 @@ def test_ring_attention_grad():
     q, k, v = _rand_qkv(B=1, H=1, T=32, D=8, seed=4)
 
     from functools import partial
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.parallel.ring_attention import ring_attention
 
     spec = P(None, None, "sp", None)
 
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(), check_rep=False)
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(), check_vma=False)
     def loss_ring(qs, ks, vs):
         o = ring_attention(qs, ks, vs, "sp")
         return jax.lax.psum((o ** 2).sum(), "sp")
