@@ -10,8 +10,20 @@ XLA schedules it without a hand-written bwd kernel.
 
 Supports causal masking and per-sequence key lengths (`kv_lens`) — the
 padding-mask case of the Fluid transformer — without materializing any
-[T, S] bias tensor.  On CPU (tests) the same kernel runs under
-``interpret=True``.
+[T, S] bias tensor.  TPU-lowering notes:
+
+* `kv_lens` rides the scalar-prefetch path (`pltpu.PrefetchScalarGridSpec`,
+  SMEM) — a (1, 1)-blocked VMEM operand is not a legal Mosaic block for a
+  [B·H]-shaped array.
+* m/l scratch are lane-padded to (block_q, 128); Mosaic vector layouts
+  want the minor dim to be a multiple of 128 (or the full array dim).
+* causal masking matches ``mha_reference``'s ``tril(k=S-T)`` — query row t
+  attends keys up to ``t + S - T`` — and fully-masked key blocks are
+  skipped via ``pl.when`` on the grid indices (≈2× on long causal seqs).
+
+On CPU (tests) the same kernel runs under ``interpret=True``; the mode is
+inferred from the *input arrays'* platform when they are concrete, falling
+back to the default backend under tracing.
 """
 from __future__ import annotations
 
@@ -45,13 +57,14 @@ def mha_reference(q, k, v, causal=False, sm_scale=None, kv_lens=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _fwd_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_k_blocks):
+def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_k_blocks, q_len, kv_len):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    ki = pl.program_id(2)
+    b = pl.program_id(0)
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -59,37 +72,57 @@ def _fwd_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, ac
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    kvl = kvlen_ref[0]  # valid key length for this (batch, head)
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)  # [bk, d]
-    # zero invalid k/v rows: 0·NaN from OOB-padded tail tiles would poison
-    # the p·v accumulation even where p is 0
-    kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
-    k = jnp.where(kcol < kvl, k, 0.0)
-    v = jnp.where(kcol < kvl, v, 0.0)
+    kvl = lens_ref[b]  # valid key length for this (batch, head)
 
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    ok = col < kvl
+    # Skip key blocks that are entirely masked: past the sequence's valid
+    # length, or (causal) strictly above this query block's last visible
+    # diagonal.  Correctness doesn't depend on this — NEG_INF masking
+    # below zeroes their contribution — it only saves the work.
+    visible = ki * block_k < kvl
     if causal:
-        ok = ok & (row >= col)
-    s = jnp.where(ok, s, NEG_INF)
+        visible = jnp.logical_and(
+            visible, ki * block_k <= qi * block_q + block_q - 1 + (kv_len - q_len)
+        )
 
-    m_prev = m_scr[:]  # [bq, 1]
-    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
-    l_scr[:] = l_scr[:] * alpha + p.sum(axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        # zero invalid k/v rows: 0·NaN from OOB-padded tail tiles would
+        # poison the p·v accumulation even where p is 0
+        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+        k = jnp.where(kcol < kvl, k, 0.0)
+        v = jnp.where(kcol < kvl, v, 0.0)
+
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        ok = col < kvl
+        if causal:
+            # query row t sees keys [0, t + S - T] — tril(k=S-T), matching
+            # mha_reference for T != S (bottom-right aligned)
+            ok = ok & (row + (kv_len - q_len) >= col)
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0:1] * alpha + p.sum(axis=1, keepdims=True)
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
-        denom = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(denom))[:, 0]
+        denom = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[0] = (acc_scr[:, :] / denom).astype(o_ref.dtype)
+        # lane-replicated: a (1, bq)-blocked rank-2 output is not a legal
+        # Mosaic block, so lse ships as [bh, T, 128] and lane 0 is read back
+        lse_ref[0] = jnp.broadcast_to(m_scr[:, 0:1] + jnp.log(denom), lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
@@ -108,39 +141,45 @@ def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
     kr = k.reshape(bh, S, D)
     vr = v.reshape(bh, S, D)
     if kv_lens is None:
-        lens_bh = jnp.full((bh, 1), S, jnp.int32)
+        lens_bh = jnp.full((bh,), S, jnp.int32)
     else:
-        lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), H).reshape(bh, 1)
+        lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), H)
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=bq, block_k=bk, num_k_blocks=nk,
+        block_q=bq, block_k=bk, num_k_blocks=nk, q_len=T, kv_len=S,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j, lens: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j, lens: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j, lens: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j, lens: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j, lens: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running sum (lane-replicated)
+            pltpu.VMEM((bq, D), jnp.float32),    # output accumulator
+        ],
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-        ],
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, T, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, T), jnp.float32),
+            jax.ShapeDtypeStruct((bh, T, 128), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, D), jnp.float32),
-        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(lens_bh, qr, kr, vr)
-    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+    return out.reshape(B, H, T, D), lse[:, :, 0].reshape(B, H, T)
 
 
 def _flash_bwd(causal, sm_scale, block_k, res, do):
@@ -175,7 +214,8 @@ def _flash_bwd(causal, sm_scale, block_k, res, do):
         cols = j0 + jnp.arange(bk)
         valid = cols[None, None, None, :] < klim[:, None, None, None]
         if causal:
-            valid = valid & (rows[:, None] >= cols[None, :])[None, None]
+            # same bottom-right-aligned tril(k=S-T) as the forward kernel
+            valid = valid & (rows[:, None] + (S - T) >= cols[None, :])[None, None]
         p = jnp.where(valid, jnp.exp(s - lse[..., :, None]), 0.0)  # [B,H,T,bk]
         dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vj)
@@ -192,6 +232,22 @@ def _flash_bwd(causal, sm_scale, block_k, res, do):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _infer_interpret(x):
+    """Pallas interpret mode: off only when the inputs live on a TPU.
+
+    Concrete arrays report their platform directly; tracers (inside jit)
+    don't carry devices, so fall back to the default backend — which is
+    what the surrounding jit will compile for absent explicit placement.
+    """
+    try:
+        platforms = {d.platform for d in x.devices()}
+        if platforms:
+            return "tpu" not in platforms
+    except Exception:
+        pass
+    return jax.default_backend() != "tpu"
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, kv_lens=None, causal=False, sm_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=None):
@@ -202,10 +258,18 @@ def flash_attention(q, k, v, kv_lens=None, causal=False, sm_scale=None,
 
 
 def _flash_impl(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+    if causal and q.shape[2] > k.shape[2]:
+        # Bottom-right-aligned tril(k=S-T) leaves rows t < T-S with zero
+        # visible keys; the online softmax has no meaningful value there
+        # (the reference degenerates to a uniform mean over masked keys).
+        raise ValueError(
+            "causal flash_attention requires T <= S, got T=%d S=%d"
+            % (q.shape[2], k.shape[2])
+        )
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _infer_interpret(q)
     return _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret)
 
 
