@@ -1,9 +1,21 @@
-"""Composite networks (reference: python/paddle/fluid/nets.py)."""
+"""Composite networks.
+
+Parity surface: python/paddle/fluid/nets.py (same public helpers and
+keyword contracts — callers port unchanged); bodies are built on this
+repo's graph layers and XLA fusion does the cross-op optimization the
+reference left to cuDNN.
+"""
 from __future__ import annotations
 
 from . import layers
 
-__all__ = ["simple_img_conv_pool", "sequence_conv_pool", "glu", "scaled_dot_product_attention", "img_conv_group"]
+__all__ = [
+    "simple_img_conv_pool",
+    "sequence_conv_pool",
+    "glu",
+    "scaled_dot_product_attention",
+    "img_conv_group",
+]
 
 
 def simple_img_conv_pool(
@@ -25,6 +37,7 @@ def simple_img_conv_pool(
     use_cudnn=True,
     use_mkldnn=False,
 ):
+    """One conv2d followed by one pool2d (LeNet-style building block)."""
     conv_out = layers.conv2d(
         input=input,
         num_filters=num_filters,
@@ -37,7 +50,7 @@ def simple_img_conv_pool(
         bias_attr=bias_attr,
         act=act,
     )
-    pool_out = layers.pool2d(
+    return layers.pool2d(
         input=conv_out,
         pool_size=pool_size,
         pool_type=pool_type,
@@ -45,7 +58,6 @@ def simple_img_conv_pool(
         pool_padding=pool_padding,
         global_pooling=global_pooling,
     )
-    return pool_out
 
 
 def img_conv_group(
@@ -63,91 +75,107 @@ def img_conv_group(
     use_cudnn=True,
     use_mkldnn=False,
 ):
-    tmp = input
-    assert isinstance(conv_num_filter, (list, tuple))
+    """VGG-style block: a stack of conv layers (each optionally followed by
+    batch norm + dropout, with the activation moved onto the batch norm),
+    capped by a single pooling layer.
 
-    def __extend_list__(obj):
-        if not hasattr(obj, "__len__"):
-            return [obj] * len(conv_num_filter)
-        return list(obj)
+    ``conv_num_filter`` is a list — one entry per conv.  Every other
+    per-conv setting may be given either as one value (applied to every
+    conv) or as a list of the same length.
+    """
+    if not isinstance(conv_num_filter, (list, tuple)):
+        raise TypeError("conv_num_filter must be a list/tuple of filter counts")
+    depth = len(conv_num_filter)
 
-    conv_padding = __extend_list__(conv_padding)
-    conv_filter_size = __extend_list__(conv_filter_size)
-    param_attr = __extend_list__(param_attr)
-    conv_with_batchnorm = __extend_list__(conv_with_batchnorm)
-    conv_batchnorm_drop_rate = __extend_list__(conv_batchnorm_drop_rate)
+    def broadcast(setting):
+        """One value -> repeated per conv; a list must match the depth."""
+        if hasattr(setting, "__len__"):
+            if len(setting) != depth:
+                raise ValueError(
+                    "per-conv setting %r has length %d, want %d"
+                    % (setting, len(setting), depth)
+                )
+            return list(setting)
+        return [setting] * depth
 
-    for i in range(len(conv_num_filter)):
-        local_conv_act = conv_act
-        if conv_with_batchnorm[i]:
-            local_conv_act = None
-        tmp = layers.conv2d(
-            input=tmp,
-            num_filters=conv_num_filter[i],
-            filter_size=conv_filter_size[i],
-            padding=conv_padding[i],
-            param_attr=param_attr[i],
-            act=local_conv_act,
+    layer_configs = zip(
+        conv_num_filter,
+        broadcast(conv_filter_size),
+        broadcast(conv_padding),
+        broadcast(param_attr),
+        broadcast(conv_with_batchnorm),
+        broadcast(conv_batchnorm_drop_rate),
+    )
+
+    x = input
+    for filters, fsize, pad, attr, with_bn, drop_rate in layer_configs:
+        x = layers.conv2d(
+            input=x,
+            num_filters=filters,
+            filter_size=fsize,
+            padding=pad,
+            param_attr=attr,
+            act=None if with_bn else conv_act,
         )
-        if conv_with_batchnorm[i]:
-            tmp = layers.batch_norm(input=tmp, act=conv_act)
-            drop_rate = conv_batchnorm_drop_rate[i]
+        if with_bn:
+            x = layers.batch_norm(input=x, act=conv_act)
             if abs(drop_rate) > 1e-5:
-                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
-    pool_out = layers.pool2d(input=tmp, pool_size=pool_size, pool_type=pool_type, pool_stride=pool_stride)
-    return pool_out
+                x = layers.dropout(x=x, dropout_prob=drop_rate)
+
+    return layers.pool2d(
+        input=x, pool_size=pool_size, pool_type=pool_type, pool_stride=pool_stride
+    )
 
 
 def sequence_conv_pool(input, num_filters, filter_size, param_attr=None, act="sigmoid", pool_type="max"):
+    """sequence_conv then sequence_pool (text-CNN building block)."""
     conv_out = layers.sequence_conv(
         input=input, num_filters=num_filters, filter_size=filter_size, param_attr=param_attr, act=act
     )
-    pool_out = layers.sequence_pool(input=conv_out, pool_type=pool_type)
-    return pool_out
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
 
 
 def glu(input, dim=-1):
+    """Gated linear unit: split in two along ``dim``, gate one half by the
+    sigmoid of the other."""
     a, b = layers.split(input, num_or_sections=2, dim=dim)
-    act_b = layers.sigmoid(x=b)
-    out = layers.elementwise_mul(x=a, y=act_b)
-    return out
+    return layers.elementwise_mul(x=a, y=layers.sigmoid(x=b))
 
 
 def scaled_dot_product_attention(queries, keys, values, num_heads=1, dropout_rate=0.0):
-    """Multi-head scaled dot-product attention (reference nets.py:233).
-    Inputs [batch, len, d]; returns [batch, q_len, d_v]."""
-    if not (len(queries.shape) == len(keys.shape) == len(values.shape) == 3):
-        raise ValueError("inputs must be 3-D")
+    """Multi-head scaled dot-product attention over [batch, len, d] inputs;
+    returns [batch, q_len, d_v].  Head split/merge are free reshapes under
+    XLA; the two matmuls land on the MXU."""
+    for name, t in (("queries", queries), ("keys", keys), ("values", values)):
+        if len(t.shape) != 3:
+            raise ValueError("%s must be 3-D [batch, len, hidden]" % name)
     if queries.shape[-1] != keys.shape[-1]:
         raise ValueError("queries and keys must have the same hidden size")
     if keys.shape[1] != values.shape[1]:
         raise ValueError("keys and values must have the same length")
-    if queries.shape[-1] % num_heads != 0 or values.shape[-1] % num_heads != 0:
+    if queries.shape[-1] % num_heads or values.shape[-1] % num_heads:
         raise ValueError("hidden size must be divisible by num_heads")
 
-    def __split_heads(x, num_heads):
+    def to_heads(x):
+        """[b, t, d] -> [b, heads, t, d/heads] (identity for one head)."""
         if num_heads == 1:
             return x
         b, t, d = x.shape
-        reshaped = layers.reshape(x=x, shape=[b if b > 0 else -1, t, num_heads, d // num_heads])
-        return layers.transpose(x=reshaped, perm=[0, 2, 1, 3])
+        x = layers.reshape(x=x, shape=[b if b > 0 else -1, t, num_heads, d // num_heads])
+        return layers.transpose(x=x, perm=[0, 2, 1, 3])
 
-    def __combine_heads(x):
+    def from_heads(x):
+        """Inverse of to_heads."""
         if len(x.shape) == 3:
             return x
-        trans_x = layers.transpose(x, perm=[0, 2, 1, 3])
-        b, t, h, d = trans_x.shape
-        return layers.reshape(x=trans_x, shape=[b if b > 0 else -1, t, h * d])
+        x = layers.transpose(x, perm=[0, 2, 1, 3])
+        b, t, h, d = x.shape
+        return layers.reshape(x=x, shape=[b if b > 0 else -1, t, h * d])
 
-    q = __split_heads(queries, num_heads)
-    k = __split_heads(keys, num_heads)
-    v = __split_heads(values, num_heads)
-
-    key_dim_per_head = keys.shape[-1] // num_heads
-    scaled_q = layers.scale(x=q, scale=key_dim_per_head**-0.5)
-    product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
-    weights = layers.softmax(product)
+    depth_per_head = keys.shape[-1] // num_heads
+    q = layers.scale(x=to_heads(queries), scale=depth_per_head**-0.5)
+    scores = layers.matmul(x=q, y=to_heads(keys), transpose_y=True)
+    weights = layers.softmax(scores)
     if dropout_rate:
         weights = layers.dropout(weights, dropout_prob=dropout_rate, is_test=False)
-    ctx_multiheads = layers.matmul(weights, v)
-    return __combine_heads(ctx_multiheads)
+    return from_heads(layers.matmul(weights, to_heads(values)))

@@ -201,10 +201,12 @@ def _batch_norm(ctx, op):
         m = jnp.mean(xf, axis=axes)
         v = jnp.var(xf, axis=axes)
         saved_m, saved_v = m, v
-        new_mean = mean * momentum + jax.lax.stop_gradient(m) * (1 - momentum)
-        new_var = var * momentum + jax.lax.stop_gradient(v) * (1 - momentum)
-        ctx.set_output(op, "MeanOut", new_mean)
-        ctx.set_output(op, "VarianceOut", new_var)
+        # f32 stat math, stored back in the stat vars' own dtype — a dtype
+        # change between input and output state would retrigger jit
+        new_mean = mean.astype(jnp.float32) * momentum + jax.lax.stop_gradient(m) * (1 - momentum)
+        new_var = var.astype(jnp.float32) * momentum + jax.lax.stop_gradient(v) * (1 - momentum)
+        ctx.set_output(op, "MeanOut", new_mean.astype(mean.dtype))
+        ctx.set_output(op, "VarianceOut", new_var.astype(var.dtype))
     inv = jax.lax.rsqrt(v + eps)
     y = (xf - m.reshape(shape)) * inv.reshape(shape) * scale.reshape(shape) + bias.reshape(shape)
     ctx.set_output(op, "Y", y.astype(x.dtype))

@@ -4,32 +4,53 @@
 Each rule reads Param/Grad/accumulators from the env and binds the updated
 values to the *same* variable names (ParamOut aliases Param, as in the
 reference), so the Executor's functional state threading gives in-place
-semantics after XLA buffer donation.  All update math runs in f32 even when
-params are bf16 (master-weight behavior comes from keeping params f32 and
-casting at use sites instead).
+semantics after XLA buffer donation.
+
+Dtype discipline (master-weight math): all update arithmetic runs in f32 —
+half-precision params/grads are upcast on read, the new param is cast back
+to the param's stored dtype on write, and accumulators are always written
+f32 (optimizer.py declares them f32).  Besides precision, this keeps the
+state dtypes fixed across steps: an output dtype that differs from the
+input's would retrigger jit compilation every step.
 """
 from __future__ import annotations
 
 from ..registry import register
 
 
+def _f32(x):
+    import jax.numpy as jnp
+
+    if hasattr(x, "dtype") and x.dtype in (jnp.bfloat16, jnp.float16):
+        return x.astype(jnp.float32)
+    return x
+
+
+def _read(ctx, op, *slots):
+    """Fetch inputs upcast to f32 for the update math."""
+    return [_f32(ctx.get_input(op, s)) for s in slots]
+
+
+def _write_param(ctx, op, new_value, slot="ParamOut"):
+    """Store the updated param in its original dtype."""
+    orig = ctx.get_input(op, "Param")
+    ctx.set_output(op, slot, new_value.astype(orig.dtype))
+
+
 def _lr(ctx, op):
-    lr = ctx.get_input(op, "LearningRate")
+    lr = _f32(ctx.get_input(op, "LearningRate"))
     return lr.reshape(()) if hasattr(lr, "reshape") else lr
 
 
 @register("sgd")
 def _sgd(ctx, op):
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    ctx.set_output(op, "ParamOut", p - _lr(ctx, op) * g)
+    p, g = _read(ctx, op, "Param", "Grad")
+    _write_param(ctx, op, p - _lr(ctx, op) * g)
 
 
 @register("momentum")
 def _momentum(ctx, op):
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    v = ctx.get_input(op, "Velocity")
+    p, g, v = _read(ctx, op, "Param", "Grad", "Velocity")
     mu = op.attrs["mu"]
     lr = _lr(ctx, op)
     v_new = mu * v + g
@@ -37,7 +58,7 @@ def _momentum(ctx, op):
         p_new = p - (g + mu * v_new) * lr
     else:
         p_new = p - lr * v_new
-    ctx.set_output(op, "ParamOut", p_new)
+    _write_param(ctx, op, p_new)
     ctx.set_output(op, "VelocityOut", v_new)
 
 
@@ -45,12 +66,9 @@ def _momentum(ctx, op):
 def _adam(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    m = ctx.get_input(op, "Moment1")
-    v = ctx.get_input(op, "Moment2")
-    b1p = ctx.get_input(op, "Beta1Pow")
-    b2p = ctx.get_input(op, "Beta2Pow")
+    p, g, m, v, b1p, b2p = _read(
+        ctx, op, "Param", "Grad", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"
+    )
     b1 = op.attrs.get("beta1", 0.9)
     b2 = op.attrs.get("beta2", 0.999)
     eps = op.attrs.get("epsilon", 1e-8)
@@ -59,7 +77,7 @@ def _adam(ctx, op):
     v_new = b2 * v + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
-    ctx.set_output(op, "ParamOut", p_new)
+    _write_param(ctx, op, p_new)
     ctx.set_output(op, "Moment1Out", m_new)
     ctx.set_output(op, "Moment2Out", v_new)
     ctx.set_output(op, "Beta1PowOut", b1p * b1)
@@ -70,13 +88,11 @@ def _adam(ctx, op):
 def _adagrad(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    mom = ctx.get_input(op, "Moment")
+    p, g, mom = _read(ctx, op, "Param", "Grad", "Moment")
     eps = op.attrs.get("epsilon", 1e-6)
     m_new = mom + g * g
     p_new = p - _lr(ctx, op) * g / (jnp.sqrt(m_new) + eps)
-    ctx.set_output(op, "ParamOut", p_new)
+    _write_param(ctx, op, p_new)
     ctx.set_output(op, "MomentOut", m_new)
 
 
@@ -84,14 +100,12 @@ def _adagrad(ctx, op):
 def _decayed_adagrad(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    mom = ctx.get_input(op, "Moment")
+    p, g, mom = _read(ctx, op, "Param", "Grad", "Moment")
     decay = op.attrs.get("decay", 0.95)
     eps = op.attrs.get("epsilon", 1e-6)
     m_new = decay * mom + (1 - decay) * g * g
     p_new = p - _lr(ctx, op) * g / (jnp.sqrt(m_new) + eps)
-    ctx.set_output(op, "ParamOut", p_new)
+    _write_param(ctx, op, p_new)
     ctx.set_output(op, "MomentOut", m_new)
 
 
@@ -99,16 +113,15 @@ def _decayed_adagrad(ctx, op):
 def _adadelta(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    avg_sq_g = ctx.get_input(op, "AvgSquaredGrad")
-    avg_sq_u = ctx.get_input(op, "AvgSquaredUpdate")
+    p, g, avg_sq_g, avg_sq_u = _read(
+        ctx, op, "Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"
+    )
     rho = op.attrs.get("rho", 0.95)
     eps = op.attrs.get("epsilon", 1e-6)
     g2 = rho * avg_sq_g + (1 - rho) * g * g
     upd = jnp.sqrt(avg_sq_u + eps) / jnp.sqrt(g2 + eps) * g
     u2 = rho * avg_sq_u + (1 - rho) * upd * upd
-    ctx.set_output(op, "ParamOut", p - upd)
+    _write_param(ctx, op, p - upd)
     ctx.set_output(op, "AvgSquaredGradOut", g2)
     ctx.set_output(op, "AvgSquaredUpdateOut", u2)
 
@@ -117,11 +130,9 @@ def _adadelta(ctx, op):
 def _adamax(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    m = ctx.get_input(op, "Moment")
-    inf_norm = ctx.get_input(op, "InfNorm")
-    b1p = ctx.get_input(op, "Beta1Pow")
+    p, g, m, inf_norm, b1p = _read(
+        ctx, op, "Param", "Grad", "Moment", "InfNorm", "Beta1Pow"
+    )
     b1 = op.attrs.get("beta1", 0.9)
     b2 = op.attrs.get("beta2", 0.999)
     eps = op.attrs.get("epsilon", 1e-8)
@@ -129,7 +140,7 @@ def _adamax(ctx, op):
     m_new = b1 * m + (1 - b1) * g
     n_new = jnp.maximum(b2 * inf_norm, jnp.abs(g))
     p_new = p - (lr / (1 - b1p.reshape(()))) * m_new / (n_new + eps)
-    ctx.set_output(op, "ParamOut", p_new)
+    _write_param(ctx, op, p_new)
     ctx.set_output(op, "MomentOut", m_new)
     ctx.set_output(op, "InfNormOut", n_new)
 
@@ -138,23 +149,20 @@ def _adamax(ctx, op):
 def _rmsprop(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    ms = ctx.get_input(op, "MeanSquare")
-    mom = ctx.get_input(op, "Moment")
+    p, g, ms, mom = _read(ctx, op, "Param", "Grad", "MeanSquare", "Moment")
     rho = op.attrs.get("decay", 0.95)
     eps = op.attrs.get("epsilon", 1e-6)
     momentum = op.attrs.get("momentum", 0.0)
     lr = _lr(ctx, op)
     ms_new = rho * ms + (1 - rho) * g * g
     if op.attrs.get("centered", False):
-        mg = ctx.get_input(op, "MeanGrad")
+        (mg,) = _read(ctx, op, "MeanGrad")
         mg_new = rho * mg + (1 - rho) * g
         mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new - mg_new * mg_new + eps)
         ctx.set_output(op, "MeanGradOut", mg_new)
     else:
         mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
-    ctx.set_output(op, "ParamOut", p - mom_new)
+    _write_param(ctx, op, p - mom_new)
     ctx.set_output(op, "MeanSquareOut", ms_new)
     ctx.set_output(op, "MomentOut", mom_new)
 
@@ -163,10 +171,9 @@ def _rmsprop(ctx, op):
 def _ftrl(ctx, op):
     import jax.numpy as jnp
 
-    p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
-    sq = ctx.get_input(op, "SquaredAccumulator")
-    lin = ctx.get_input(op, "LinearAccumulator")
+    p, g, sq, lin = _read(
+        ctx, op, "Param", "Grad", "SquaredAccumulator", "LinearAccumulator"
+    )
     l1 = op.attrs.get("l1", 0.0)
     l2 = op.attrs.get("l2", 0.0)
     power = op.attrs.get("lr_power", -0.5)
@@ -183,7 +190,7 @@ def _ftrl(ctx, op):
         denom = new_sq ** (-power) / lr + 2 * l2
     pre = jnp.clip(new_lin, -l1, l1) - new_lin
     p_new = jnp.where(jnp.abs(new_lin) > l1, pre / denom, jnp.zeros_like(p))
-    ctx.set_output(op, "ParamOut", p_new)
+    _write_param(ctx, op, p_new)
     ctx.set_output(op, "SquaredAccumOut", new_sq)
     ctx.set_output(op, "LinearAccumOut", new_lin)
 
@@ -191,8 +198,7 @@ def _ftrl(ctx, op):
 @register("average_accumulate")
 def _average_accumulate(ctx, op):
     """ModelAverage accumulator (reference operators/average_accumulates_op)."""
-    p = ctx.get_input(op, "Param")
-    s = ctx.get_input(op, "Sum")
+    p, s = _read(ctx, op, "Param", "Sum")
     n = ctx.get_input(op, "Num")
     ctx.set_output(op, "SumOut", s + p)
     ctx.set_output(op, "NumOut", n + 1)

@@ -93,10 +93,17 @@ class Optimizer:
         if param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         helper = LayerHelper(self.__class__.__name__)
+        # Accumulators live in f32 regardless of param dtype: the update-op
+        # lowerings do all math in f32 (master-weight recipe), and a
+        # half-precision accumulator would both lose small updates and flip
+        # the state dtype between steps (retriggering jit compilation).
+        acc_dtype = dtype or param.dtype
+        if str(acc_dtype) in ("bfloat16", "float16"):
+            acc_dtype = "float32"
         var = helper.create_global_variable(
             name=unique_name.generate(param.name + "_" + name),
             persistable=True,
-            dtype=dtype or param.dtype,
+            dtype=acc_dtype,
             shape=shape if shape is not None else param.shape,
         )
         var.stop_gradient = True
@@ -518,7 +525,8 @@ class ModelAverage(Optimizer):
                 self._backup[p.name] = np.asarray(scope[p.name])
                 s = np.asarray(scope[self._get_accumulator("sum", p).name])
                 n = max(int(np.asarray(scope[self._get_accumulator("num_accumulates", p).name])[0]), 1)
-                scope[p.name] = s / n
+                # the f32 running sum must not change the param's stored dtype
+                scope[p.name] = (s / n).astype(self._backup[p.name].dtype)
             try:
                 yield
             finally:

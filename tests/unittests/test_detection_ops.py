@@ -182,3 +182,37 @@ def test_detection_map_metric():
     dm = metrics.DetectionMAP(num_classes=3)
     dm.update(dets, gt_boxes, gt_labels, gt_lens)
     np.testing.assert_allclose(dm.eval(), 0.5, atol=1e-6)
+
+
+def test_detection_map_pools_tp_fp_across_batches():
+    """mAP must come from one global PR curve over all updates — not the
+    mean of per-batch mAPs (regression: per-batch averaging misorders
+    scores across batches)."""
+    from paddle_tpu import metrics
+
+    K = 4
+    pad = [[-1, 0, 0, 0, 0, 0]]
+    # batch A: one image, one gt, one perfect detection at score 0.9
+    det_a = np.array([[[1, 0.9, 0, 0, 1, 1]] + pad * (K - 1)], np.float32)
+    gt_a = np.array([[[0, 0, 1, 1]]], np.float32)
+    lab_a = np.array([[1]], np.int64)
+    len_a = np.array([1], np.int64)
+    # batch B: one image, one gt; a higher-scored FP plus a lower-scored TP
+    det_b = np.array([[[1, 0.95, 5, 5, 6, 6], [1, 0.5, 0, 0, 1, 1]] + pad * (K - 2)], np.float32)
+    gt_b = np.array([[[0, 0, 1, 1]]], np.float32)
+    lab_b = np.array([[1]], np.int64)
+    len_b = np.array([1], np.int64)
+
+    m = metrics.DetectionMAP(num_classes=2)
+    m.update(det_a, gt_a, lab_a, len_a)
+    m.update(det_b, gt_b, lab_b, len_b)
+    pooled = m.eval()
+
+    per_batch_avg = np.mean([
+        metrics.compute_detection_map(d, g, l, n, num_classes=2)
+        for d, g, l, n in [(det_a, gt_a, lab_a, len_a), (det_b, gt_b, lab_b, len_b)]
+    ])
+    # pooled ranking: fp@0.95, tp@0.9, tp@0.5 -> AP = 2/3
+    np.testing.assert_allclose(pooled, 2.0 / 3.0, rtol=1e-6)
+    assert abs(per_batch_avg - 0.75) < 1e-6  # what the buggy average would say
+    assert abs(pooled - per_batch_avg) > 0.05
