@@ -11,7 +11,11 @@
 // Wire protocol (little-endian):
 //   request  := op:u8 | table_len:u16 | table_bytes | payload
 //   op 0 (INIT):  rows:u32 | width:u32           -> status:u8
-//   op 1 (PUSH):  lr:f32 | n:u32 | (row_id:u32 | f32*width)*n -> status:u8
+//   op 1 (PUSH):  lr:f32 | width:u32 | n:u32 | (row_id:u32 | f32*width)*n -> status:u8
+//       width is the *client's* row width: the server can then drain the
+//       whole payload (keeping the stream in sync) even when the table is
+//       unknown or the widths disagree, answering status=0 instead of
+//       desynchronizing the protocol.
 //   op 2 (PULL):  n:u32 | (row_id:u32)*n         -> status:u8 | f32*width*n
 //   op 3 (SAVE):  path_len:u16 | path            -> status:u8
 //   op 4 (SHUTDOWN)                              -> status:u8
@@ -95,21 +99,24 @@ struct Server {
         if (!write_all(fd, &ok, 1)) break;
       } else if (op == 1) {  // PUSH (server-side SGD on rows)
         float lr;
-        uint32_t n;
-        if (!read_all(fd, &lr, 4) || !read_all(fd, &n, 4)) break;
+        uint32_t width, n;
+        if (!read_all(fd, &lr, 4) || !read_all(fd, &width, 4) || !read_all(fd, &n, 4)) break;
         Table* t;
         {
           std::lock_guard<std::mutex> lk(tables_mu);
           auto it = tables.find(table);
           t = it == tables.end() ? nullptr : &it->second;
         }
-        if (!t) { ok = 0; }
-        std::vector<float> grad(t ? t->width : 0);
+        bool apply = t && t->width == width;
+        if (!apply) ok = 0;
+        // always consume the full payload (client-declared width) so an
+        // unknown table / width mismatch can't desync the connection
+        std::vector<float> grad(width);
         for (uint32_t i = 0; i < n; ++i) {
           uint32_t row;
           if (!read_all(fd, &row, 4)) return;
-          if (!read_all(fd, grad.data(), grad.size() * 4)) return;
-          if (t && row < t->rows) {
+          if (width && !read_all(fd, grad.data(), size_t(width) * 4)) return;
+          if (apply && row < t->rows) {
             std::lock_guard<std::mutex> lk(t->row_locks[row]);
             float* dst = &t->data[size_t(row) * t->width];
             for (uint32_t j = 0; j < t->width; ++j) dst[j] -= lr * grad[j];

@@ -47,8 +47,26 @@ class MetricBase:
         return {}
 
     def reset(self):
-        for field, zero in self._zero_state().items():
-            setattr(self, field, copy.deepcopy(zero))
+        schema = self._zero_state()
+        if schema:
+            for field, zero in schema.items():
+                setattr(self, field, copy.deepcopy(zero))
+            return
+        # No declared schema (external subclass in the reference style, state
+        # attrs assigned in __init__): zero every public attribute by type.
+        for attr, value in list(self.__dict__.items()):
+            if attr.startswith("_"):
+                continue
+            if isinstance(value, bool):
+                setattr(self, attr, False)
+            elif isinstance(value, int):
+                setattr(self, attr, 0)
+            elif isinstance(value, float):
+                setattr(self, attr, 0.0)
+            elif isinstance(value, (np.ndarray, np.generic)):
+                setattr(self, attr, np.zeros_like(value))
+            else:
+                setattr(self, attr, None)
 
     def get_config(self):
         snapshot = {

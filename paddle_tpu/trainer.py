@@ -169,6 +169,10 @@ class Trainer:
             for epoch_id in range(self._epoch_start, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
                 for step_id, data in enumerate(reader()):
+                    if epoch_id == self._epoch_start and step_id < self._step_start:
+                        # already applied before the checkpoint this run
+                        # resumed from — replaying would double-count them
+                        continue
                     if self.__stopped:
                         return
                     begin = BeginStepEvent(epoch_id, step_id)
@@ -184,7 +188,10 @@ class Trainer:
                         serial += 1
                         save_checkpoint(
                             self.exe, cfg.checkpoint_dir, self.train_program, serial,
-                            {"epoch": epoch_id, "step": step_id}, cfg.max_num_checkpoints,
+                            # "step" counts *completed* steps this epoch, so a
+                            # resume skips exactly [0, step) and the epoch-end
+                            # checkpoint's step=0 means "skip nothing"
+                            {"epoch": epoch_id, "step": step_id + 1}, cfg.max_num_checkpoints,
                         )
                 event_handler(EndEpochEvent(epoch_id))
                 cfg = self.checkpoint_cfg

@@ -8,8 +8,10 @@ jitted XLA step per sync round; trainers overlap compute and RPC naturally
 because the send happens after the step's fetches materialize.
 
 Sync semantics: with ``Fanin`` trainers, the server barriers each round:
-grads from all trainers are summed, optimizer ops run once, then every
-trainer's pull returns the fresh params (reference sync_mode=True).
+grads from all trainers are *averaged* (sum / Fanin — each trainer sends
+mean-loss grads for its shard, so averaging keeps the effective LR equal
+to a single-node step on the combined batch), optimizer ops run once, then
+every trainer's pull returns the fresh params (reference sync_mode=True).
 """
 from __future__ import annotations
 
@@ -108,7 +110,11 @@ class _SyncRound:
                 self.grads[k] = self.grads.get(k, 0) + np.asarray(v)
             self.count += 1
             if self.count == self.fanin:
-                apply_fn(self.grads)
+                # average over trainers: each sends mean-loss grads for its
+                # own shard of the global batch, so the sync step must apply
+                # sum/fanin or the effective LR scales with the trainer
+                # count (reference appends a 1/N scale op in sync mode)
+                apply_fn({k: v / self.fanin for k, v in self.grads.items()})
                 self.grads = {}
                 self.count = 0
                 self.generation += 1

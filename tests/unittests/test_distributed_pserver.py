@@ -114,9 +114,9 @@ def test_cpp_sparse_pserver():
     # INIT rows=10 width=4
     s = req(struct.pack("<BH", 0, len(table)) + table + struct.pack("<II", 10, 4))
     assert s.recv(1) == b"\x01"
-    # PUSH 2 rows with lr=1.0 (server-side SGD: row -= lr*grad)
+    # PUSH 2 rows with lr=1.0, width=4 (server-side SGD: row -= lr*grad)
     g = np.arange(4, dtype="float32")
-    msg = struct.pack("<BH", 1, len(table)) + table + struct.pack("<fI", 1.0, 2)
+    msg = struct.pack("<BH", 1, len(table)) + table + struct.pack("<fII", 1.0, 4, 2)
     msg += struct.pack("<I", 3) + g.tobytes()
     msg += struct.pack("<I", 7) + (2 * g).tobytes()
     s2 = req(msg)
@@ -133,6 +133,22 @@ def test_cpp_sparse_pserver():
     np.testing.assert_allclose(rows[0], -g)
     np.testing.assert_allclose(rows[1], -2 * g)
     np.testing.assert_allclose(rows[2], 0)
+
+    # PUSH to an unknown table must answer status=0 AND leave the stream in
+    # sync: a PULL pipelined on the same connection still works (regression:
+    # the server used to skip the payload bytes and desync the protocol)
+    bad = b"nope"
+    msg = struct.pack("<BH", 1, len(bad)) + bad + struct.pack("<fII", 1.0, 4, 1)
+    msg += struct.pack("<I", 0) + g.tobytes()
+    msg += struct.pack("<BH", 2, len(table)) + table + struct.pack("<I", 1)
+    msg += np.array([3], "uint32").tobytes()
+    s4 = req(msg)
+    assert s4.recv(1) == b"\x00"  # unknown table rejected
+    assert s4.recv(1) == b"\x01"  # same connection still parses correctly
+    buf = b""
+    while len(buf) < 16:
+        buf += s4.recv(16 - len(buf))
+    np.testing.assert_allclose(np.frombuffer(buf, "float32"), -g)
     L.pserver_stop(h)
 
 
@@ -212,3 +228,80 @@ def test_pserver_two_trainers_sync():
     # both trainers converged on the shared params
     np.testing.assert_allclose(results[0][1], results[1][1], atol=1e-5)
     np.testing.assert_allclose(results[0][1], w_true, atol=0.3)
+
+
+def test_sync_round_equals_single_node_step():
+    """One sync round with two trainers must move the params exactly like a
+    single-node step on the concatenated batch (regression: the barrier used
+    to apply the raw grad *sum*, scaling the effective LR by trainer count)."""
+
+    def build(init_w):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(
+                input=x, size=1,
+                param_attr=fluid.ParamAttr(
+                    name="w", initializer=fluid.initializer.Constant(init_w)),
+                bias_attr=fluid.ParamAttr(
+                    name="b", initializer=fluid.initializer.Constant(0.0)),
+            )
+            cost = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        return main, startup, cost
+
+    rng = np.random.RandomState(7)
+    Xs = [rng.randn(16, 4).astype("float32") for _ in range(2)]
+    w_true = np.array([[0.5], [-1.0], [2.0], [1.5]], "float32")
+    Ys = [X @ w_true for X in Xs]
+
+    # single node, one step on the concatenated batch
+    main, startup, cost = build(0.2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()) as sc:
+        exe.run(startup)
+        exe.run(main, feed={"x": np.concatenate(Xs), "y": np.concatenate(Ys)}, fetch_list=[cost])
+        w_single = np.asarray(fluid.global_scope().vars["w"]).copy()
+
+    # two sync trainers, one step each on their half
+    main, startup, cost = build(0.2)
+    ep = "127.0.0.1:17140"
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup, pservers=ep, trainers=2)
+    trainer_prog = t.get_trainer_program()
+    ps_prog = t.get_pserver_program(ep)
+    ps_startup = t.get_startup_program(ep, ps_prog, startup)
+
+    ps_scope = fluid.Scope()
+    ps_exe = fluid.Executor(fluid.CPUPlace())
+
+    def serve():
+        with fluid.scope_guard(ps_scope):
+            ps_exe.run(ps_startup, scope=ps_scope)
+            ps_exe.run(ps_prog, scope=ps_scope)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+
+    def run_trainer(tid):
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            exe.run(trainer_prog, feed={"x": Xs[tid], "y": Ys[tid]}, fetch_list=[cost], scope=scope)
+        if tid == 0:
+            exe.close()
+        else:
+            for c in getattr(exe, "_ps_clients", {}).values():
+                c.close()
+
+    t1 = threading.Thread(target=run_trainer, args=(1,))
+    t1.start()
+    run_trainer(0)
+    t1.join(timeout=60)
+    th.join(timeout=10)
+    assert not th.is_alive()
+    w_sync = np.asarray(ps_scope.vars["w"])
+    np.testing.assert_allclose(w_sync, w_single, rtol=1e-5, atol=1e-6)

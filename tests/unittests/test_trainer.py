@@ -91,3 +91,32 @@ def test_heartbeat_failure_detection(tmp_path):
     assert failed == ["trainer1"]
     hb.stop()
     time.sleep(0.3)
+
+
+def test_trainer_mid_epoch_resume_skips_applied_steps(tmp_path):
+    """Resume from a mid-epoch checkpoint must continue at the next step, not
+    replay steps that were applied before the checkpoint (regression: the
+    loaded step offset was ignored)."""
+    cdir = str(tmp_path / "ckpt")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=cdir, max_num_checkpoints=5, step_interval=1)
+
+    # first run: stop after step 2 of epoch 0 (3 steps applied, checkpointed
+    # each step)
+    t = fluid.Trainer(_train_func, _optimizer_func, place=fluid.CPUPlace(), checkpoint_config=cfg)
+
+    def stop_after_3(e):
+        if isinstance(e, fluid.EndStepEvent) and e.step == 2:
+            t.stop()
+
+    t.train(num_epochs=1, event_handler=stop_after_3, reader=_reader, feed_order=["x", "y"])
+
+    # second run resumes; it must execute exactly steps 3..7 of epoch 0
+    t2 = fluid.Trainer(_train_func, _optimizer_func, place=fluid.CPUPlace(), checkpoint_config=cfg)
+    assert t2._epoch_start == 0 and t2._step_start == 3
+    executed = []
+
+    def record(e):
+        if isinstance(e, fluid.EndStepEvent):
+            executed.append((e.epoch, e.step))
+    t2.train(num_epochs=1, event_handler=record, reader=_reader, feed_order=["x", "y"])
+    assert executed == [(0, s) for s in range(3, 8)], executed
