@@ -340,44 +340,85 @@ def lower_block(ctx: LoweringContext, block: Block):
 
     bw_idx = None
     for i, op in enumerate(block.ops):
-        if op.type == "backward":
+        if op.type in ("backward", "calc_gradient"):
             if bw_idx is not None:
-                raise ValueError("multiple backward ops in one block")
+                raise ValueError("multiple backward/calc_gradient ops in one block")
             bw_idx = i
     if bw_idx is None:
         interpret_ops(ctx, block.ops)
         return
 
     pre, bop, post = block.ops[:bw_idx], block.ops[bw_idx], block.ops[bw_idx + 1:]
-    loss_name = bop.inputs["Loss"][0]
-    param_names = list(bop.attrs["parameter_list"])
     no_grad = set(bop.attrs.get("no_grad_set") or ())
-    param_names = [p for p in param_names if p not in no_grad]
-    missing = [p for p in param_names if p not in ctx.env]
-    if missing:
-        raise KeyError("parameters not initialized (run startup program first): %s" % missing)
+    if bop.type == "backward":
+        target_names = [bop.inputs["Loss"][0]]
+        wrt_names = [p for p in bop.attrs["parameter_list"] if p not in no_grad]
+        missing = [p for p in wrt_names if p not in ctx.env]
+        if missing:
+            raise KeyError("parameters not initialized (run startup program first): %s" % missing)
+    else:  # calc_gradient: arbitrary targets / wrt vars (feeds included)
+        target_names = list(bop.inputs["Targets"])
+        wrt_names = [w for w in bop.inputs["Inputs"] if w not in no_grad]
+        produced = {n for o in pre for ns in o.outputs.values() for n in ns}
+        missing = [w for w in wrt_names if w not in ctx.env and w not in produced]
+        if missing:
+            raise KeyError("calc_gradient inputs not available (feed or initialize them): %s" % missing)
+        bad_targets = [t for t in target_names if t not in ctx.env and t not in produced]
+        if bad_targets:
+            raise KeyError("calc_gradient targets not produced by the program: %s" % bad_targets)
+    tg_names = list(bop.inputs.get("TargetGradients") or []) if bop.type == "calc_gradient" else []
 
     outer_env = ctx.env
+    wrt_set = set(wrt_names)
 
-    def fwd(param_vals):
+    def fwd(wrt_vals):
         env2 = dict(outer_env)
-        env2.update(param_vals)
+        env2.update(wrt_vals)
         c2 = ctx.child(env2)
-        interpret_ops(c2, pre)
-        loss = env2[loss_name]
+        if bop.type == "backward":
+            interpret_ops(c2, pre)
+        else:
+            # calc_gradient may target grads w.r.t. *intermediate* vars: the
+            # graph is cut at each wrt name — its producer still runs (for
+            # side outputs) but downstream consumers see the seeded tracer,
+            # otherwise the recomputation shadows the seed and its grad is
+            # silently zero
+            for op2 in pre:
+                interpret_ops(c2, [op2])
+                for ns in op2.outputs.values():
+                    for nm in ns:
+                        if nm in wrt_set:
+                            env2[nm] = wrt_vals[nm]
         import jax.numpy as jnp
 
-        loss_scalar = jnp.sum(loss.astype(jnp.float32))
-        return loss_scalar, env2
+        total = 0.0
+        for i, t in enumerate(target_names):
+            tv = env2[t].astype(jnp.float32)
+            if i < len(tg_names):  # explicit cotangent, constant w.r.t. the wrt vars
+                tv = tv * jax.lax.stop_gradient(env2[tg_names[i]].astype(jnp.float32))
+            total = total + jnp.sum(tv)
+        return total, env2
 
-    p0 = {p: outer_env[p] for p in param_names}
+    p0 = {p: outer_env[p] for p in wrt_names if p in outer_env}
+    # intermediate wrt vars have no ambient value yet: materialize one by
+    # replaying the prefix once (values only, no grad)
+    if len(p0) < len(wrt_names):
+        probe_env = dict(outer_env)
+        interpret_ops(ctx.child(probe_env), pre)
+        for w in wrt_names:
+            if w not in p0:
+                p0[w] = probe_env[w]
     (loss_val, env_after), grads = jax.value_and_grad(fwd, has_aux=True)(p0)
     del loss_val
     ctx.env = env_after
     import jax.numpy as jnp
 
-    ctx.env[grad_var_name(loss_name)] = jnp.ones_like(env_after[loss_name])
-    for p in param_names:
+    for i, t in enumerate(target_names):
+        if i < len(tg_names):  # the supplied cotangent IS the target's grad
+            ctx.env[grad_var_name(t)] = env_after[tg_names[i]]
+        else:
+            ctx.env[grad_var_name(t)] = jnp.ones_like(env_after[t])
+    for p in wrt_names:
         g = grads[p]
         pv = ctx.var(p)
         if pv is not None and g.dtype != np.dtype("float32") and core.canonical_dtype(str(pv.dtype)) == "float32":

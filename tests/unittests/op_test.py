@@ -1,0 +1,194 @@
+"""Per-op test harness: forward-vs-NumPy and finite-difference gradient
+checks through the public Program/Executor API.
+
+Reference analog: python/paddle/fluid/tests/unittests/op_test.py (OpTest
+with check_output / check_grad).  Same strategy, this repo's machinery:
+
+* the op under test is built into a tiny Program by a ``build`` callback
+  (so the test exercises the real layer -> lowering -> jit path, not the
+  lowering rule in isolation);
+* ``check_output`` compares the fetched result against a NumPy reference;
+* ``check_grad`` reduces the op output to a scalar through a fixed random
+  projection, fetches the analytic grads materialized by
+  ``append_backward``, and compares them against central finite
+  differences of the projected loss, element-sampled for cost.
+
+Every ``test_*_op.py`` file in this directory drives one op (family)
+through these two checks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDArray
+
+
+def _as_array(x):
+    return x.data if isinstance(x, LoDArray) else np.asarray(x)
+
+
+def _is_float(a):
+    return np.issubdtype(_as_array(a).dtype, np.floating)
+
+
+class OpHarness:
+    """One program: data vars for every input, the op via ``build``, a
+    projected scalar loss, and the analytic grads of ``grad_wrt``."""
+
+    def __init__(self, build, inputs, grad_wrt=(), seed=0):
+        self.inputs = inputs
+        self.grad_wrt = list(grad_wrt)
+        self.rng = np.random.RandomState(seed)
+        self.exe = fluid.Executor(fluid.CPUPlace())
+
+        def declare_inputs():
+            vars = {}
+            for name, value in inputs.items():
+                arr = _as_array(value)
+                vars[name] = fluid.layers.data(
+                    name=name,
+                    shape=list(arr.shape[1:]),
+                    dtype=str(arr.dtype),
+                    lod_level=1 if isinstance(value, LoDArray) else 0,
+                    # feeds under grad check must be differentiable targets
+                    stop_gradient=name not in self.grad_wrt,
+                )
+            return vars
+
+        # Probe pass: the symbolic output shape carries -1 batch dims, so
+        # run the bare op once to learn the concrete shape for the
+        # projection weights.
+        probe_main, probe_startup = fluid.Program(), fluid.Program()
+        probe_startup.random_seed = seed
+        with fluid.program_guard(probe_main, probe_startup):
+            out = build(declare_inputs())
+            probe_out = out[0] if isinstance(out, (list, tuple)) else out
+        with fluid.scope_guard(fluid.Scope()):
+            self.exe.run(probe_startup)
+            (probe_val,) = self.exe.run(
+                probe_main, feed=dict(inputs), fetch_list=[probe_out])
+        out_shape = np.asarray(probe_val).shape
+
+        self.scope = fluid.Scope()
+        self.main = fluid.Program()
+        startup = fluid.Program()
+        startup.random_seed = seed
+        with fluid.program_guard(self.main, startup):
+            out = build(declare_inputs())
+            self.outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            # project to a scalar with fixed weights: plain sum() would miss
+            # sign/permutation errors that cancel in the reduction
+            proj_np = self.rng.uniform(0.5, 1.5, size=out_shape).astype("float32")
+            proj = fluid.layers.assign(proj_np)
+            prod = fluid.layers.elementwise_mul(
+                fluid.layers.cast(self.outs[0], "float32"), proj)
+            self.loss = fluid.layers.reduce_sum(prod)
+            if self.grad_wrt:
+                # calc_gradient handles feeds and params alike (append_backward
+                # only targets Parameters)
+                block = self.main.global_block()
+                fluid.backward.calc_gradient(
+                    self.loss, [block.var(n) for n in self.grad_wrt])
+        with fluid.scope_guard(self.scope):
+            self.exe.run(startup)
+
+    def fetch(self, names):
+        with fluid.scope_guard(self.scope):
+            return self.exe.run(self.main, feed=dict(self.inputs), fetch_list=list(names))
+
+    def outputs(self):
+        return self.fetch(self.outs)
+
+    def loss_value(self, overrides=None):
+        """Projected loss with some inputs/params replaced (for FD)."""
+        feed = dict(self.inputs)
+        saved = {}
+        for name, value in (overrides or {}).items():
+            if name in feed:
+                feed[name] = value
+            else:  # parameter: poke the scope, restore after
+                saved[name] = np.asarray(self.scope.vars[name]).copy()
+                self.scope.vars[name] = value
+        try:
+            with fluid.scope_guard(self.scope):
+                (lv,) = self.exe.run(self.main, feed=feed, fetch_list=[self.loss])
+        finally:
+            for name, value in saved.items():
+                self.scope.vars[name] = value
+        return float(np.ravel(lv)[0])
+
+    def analytic_grads(self):
+        return {
+            name: g
+            for name, g in zip(
+                self.grad_wrt, self.fetch([n + "@GRAD" for n in self.grad_wrt])
+            )
+        }
+
+    def numeric_grad(self, name, eps, max_elems):
+        """Central finite differences on a sample of elements of ``name``
+        (an input feed or a parameter)."""
+        if name in self.inputs:
+            base = self.inputs[name]
+            arr = _as_array(base).astype(np.float64)
+
+            def override(perturbed):
+                if isinstance(base, LoDArray):
+                    return LoDArray(perturbed.astype(_as_array(base).dtype),
+                                    base.lengths, base.sub_lengths)
+                return perturbed.astype(_as_array(base).dtype)
+        else:
+            arr = np.asarray(self.scope.vars[name]).astype(np.float64)
+
+            def override(perturbed):
+                return perturbed.astype(np.asarray(self.scope.vars[name]).dtype)
+
+        flat_idx = np.arange(arr.size)
+        if arr.size > max_elems:
+            flat_idx = self.rng.choice(arr.size, size=max_elems, replace=False)
+        grad = np.full(arr.size, np.nan)
+        for i in flat_idx:
+            for sign, store in ((+1, "hi"), (-1, "lo")):
+                pert = arr.copy().reshape(-1)
+                pert[i] += sign * eps
+                val = self.loss_value({name: override(pert.reshape(arr.shape))})
+                if store == "hi":
+                    hi = val
+                else:
+                    lo = val
+            grad[i] = (hi - lo) / (2 * eps)
+        return grad.reshape(arr.shape), flat_idx
+
+
+def check_output(build, inputs, expected, rtol=1e-5, atol=1e-6, seed=0):
+    """Build the op over ``inputs`` and compare fetched output(s) against
+    the NumPy reference value(s) in ``expected`` (array or list)."""
+    h = OpHarness(build, inputs, seed=seed)
+    got = h.outputs()
+    want = expected if isinstance(expected, (list, tuple)) else [expected]
+    assert len(got) >= len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float64), np.asarray(w, np.float64),
+            rtol=rtol, atol=atol,
+        )
+    return got
+
+
+def check_grad(build, inputs, grad_wrt, eps=1e-2, rtol=1e-2, atol=2e-3,
+               max_elems=40, seed=0):
+    """Compare analytic grads (append_backward) of the projected loss with
+    central finite differences, for each name in ``grad_wrt`` (feed names
+    and/or parameter names)."""
+    h = OpHarness(build, inputs, grad_wrt=grad_wrt, seed=seed)
+    analytic = h.analytic_grads()
+    for name in grad_wrt:
+        a = np.asarray(analytic[name], np.float64)
+        n, idx = h.numeric_grad(name, eps=eps, max_elems=max_elems)
+        a_flat, n_flat = a.reshape(-1)[idx], n.reshape(-1)[idx]
+        np.testing.assert_allclose(
+            a_flat, n_flat, rtol=rtol, atol=atol,
+            err_msg="gradient mismatch for %r (sampled %d elements)" % (name, len(idx)),
+        )
+    return h

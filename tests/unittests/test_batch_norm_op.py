@@ -1,0 +1,43 @@
+"""batch_norm in TRAIN mode: forward vs numpy batch statistics, grads for
+input/scale/bias vs FD (reference: test_batch_norm_op.py; kernel
+operators/batch_norm_op.* — train mode is the risky path: stat reduction,
+rsqrt, and the three-way VJP)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad
+
+
+def _build(v):
+    return fluid.layers.batch_norm(
+        input=v["x"],
+        param_attr=fluid.ParamAttr(name="bn_scale"),
+        bias_attr=fluid.ParamAttr(name="bn_bias"),
+        is_test=False,
+        epsilon=1e-5,
+    )
+
+
+def test_batch_norm_train_forward():
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 3, 5, 5) * 2 + 1).astype("float32")
+    h = OpHarness(_build, {"x": x})
+    (got,) = h.outputs()
+    scale = np.asarray(h.scope.vars["bn_scale"])
+    bias = np.asarray(h.scope.vars["bn_bias"])
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5)
+    want = want * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # running stats updated toward batch stats
+    running_mean = np.asarray(h.scope.vars[h.main.global_block().ops[0].inputs["Mean"][0]])
+    np.testing.assert_allclose(
+        running_mean, 0.1 * mean.reshape(-1), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_batch_norm_train_grads():
+    rng = np.random.RandomState(1)
+    x = (rng.randn(3, 2, 4, 4) * 1.5).astype("float32")
+    check_grad(_build, {"x": x}, ["x", "bn_scale", "bn_bias"], rtol=2e-2, atol=2e-3)

@@ -1,0 +1,57 @@
+"""conv2d_transpose: forward vs an explicit scatter-accumulate NumPy
+reference, grads vs FD for input and filter (reference:
+test_conv2d_transpose_op.py; kernel operators/conv_transpose_op.*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad
+
+
+def _np_conv2d_transpose(x, w, stride, pad):
+    """x [N,C,H,W], w [C, M, kh, kw] -> [N, M, H', W'] by scattering each
+    input pixel's contribution (the literal transposed-conv definition)."""
+    N, C, H, W = x.shape
+    _, M, kh, kw = w.shape
+    Ho = (H - 1) * stride + kh - 2 * pad
+    Wo = (W - 1) * stride + kw - 2 * pad
+    full = np.zeros((N, M, (H - 1) * stride + kh, (W - 1) * stride + kw), x.dtype)
+    for n in range(N):
+        for c in range(C):
+            for i in range(H):
+                for j in range(W):
+                    full[n, :, i * stride:i * stride + kh, j * stride:j * stride + kw] += (
+                        x[n, c, i, j] * w[c]
+                    )
+    return full[:, :, pad:pad + Ho, pad:pad + Wo]
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1)])
+def test_conv2d_transpose_forward(stride, pad):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+
+    def build(v):
+        return fluid.layers.conv2d_transpose(
+            v["x"], num_filters=4, filter_size=3, stride=stride, padding=pad,
+            param_attr=fluid.ParamAttr(name="deconv_w"), bias_attr=False,
+        )
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["deconv_w"]).astype("float32")
+    want = _np_conv2d_transpose(x, w, stride, pad)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_grads():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+
+    def build(v):
+        return fluid.layers.conv2d_transpose(
+            v["x"], num_filters=2, filter_size=3, stride=2, padding=1,
+            param_attr=fluid.ParamAttr(name="deconv_w"), bias_attr=False,
+        )
+
+    check_grad(build, {"x": x}, ["x", "deconv_w"], rtol=1e-2, atol=1e-3)

@@ -1,0 +1,71 @@
+"""elementwise_{add,sub,mul,div,max,min} with the reference's axis
+broadcast (y aligned to x starting at `axis`): forward vs numpy, grads of
+BOTH operands vs FD — the broadcast reduction in the VJP is the bug-prone
+part (reference: test_elementwise_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+_OPS = {
+    "add": (fluid.layers.elementwise_add, np.add),
+    "sub": (fluid.layers.elementwise_sub, np.subtract),
+    "mul": (fluid.layers.elementwise_mul, np.multiply),
+    "div": (fluid.layers.elementwise_div, np.divide),
+    "max": (fluid.layers.elementwise_max, np.maximum),
+    "min": (fluid.layers.elementwise_min, np.minimum),
+}
+
+
+def _aligned(y, x_ndim, axis):
+    shape = (1,) * axis + y.shape + (1,) * (x_ndim - axis - y.ndim)
+    return y.reshape(shape)
+
+
+@pytest.mark.parametrize("name", sorted(_OPS))
+def test_same_shape_forward_grad(name):
+    layer, ref = _OPS[name]
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype("float32")
+    if name == "div":
+        y = (np.abs(rng.randn(3, 4)) + 1.0).astype("float32")  # away from 0
+    elif name in ("max", "min"):
+        # keep |x - y| > 2*eps so FD never straddles the tie kink
+        sign = np.where(rng.rand(3, 4) < 0.5, -1.0, 1.0)
+        y = (x + sign * (0.2 + rng.rand(3, 4))).astype("float32")
+    else:
+        y = rng.randn(3, 4).astype("float32")
+
+    def build(v):
+        return layer(v["x"], v["y"])
+
+    check_output(build, {"x": x, "y": y}, ref(x, y), rtol=1e-5)
+    check_grad(build, {"x": x, "y": y}, ["x", "y"])
+
+
+@pytest.mark.parametrize("name", ["add", "mul"])
+def test_axis_broadcast_forward_grad(name):
+    layer, ref = _OPS[name]
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+    y = rng.randn(3, 4).astype("float32")  # aligned at axis=1
+
+    def build(v):
+        return layer(v["x"], v["y"], axis=1)
+
+    check_output(build, {"x": x, "y": y}, ref(x, _aligned(y, 4, 1)), rtol=1e-5)
+    # y's grad must be the cotangent reduced over the broadcast dims
+    check_grad(build, {"x": x, "y": y}, ["x", "y"])
+
+
+def test_trailing_broadcast_default_axis():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 3, 6).astype("float32")
+    y = rng.randn(6).astype("float32")
+
+    def build(v):
+        return fluid.layers.elementwise_add(v["x"], v["y"])
+
+    check_output(build, {"x": x, "y": y}, x + y, rtol=1e-5)
+    check_grad(build, {"x": x, "y": y}, ["x", "y"])

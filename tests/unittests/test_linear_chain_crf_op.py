@@ -1,0 +1,66 @@
+"""linear_chain_crf: NLL vs brute-force enumeration over all tag paths,
+gradients (emission + transition) vs finite differences (reference:
+test_linear_chain_crf_op.py; kernel operators/linear_chain_crf_op.*)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness, check_grad
+
+
+def _path_score(emis, tags, w, K):
+    """start[tags0] + sum emis + sum trans + end[tagsT]; w is [K+2, K]
+    (row 0 start, row 1 end, rows 2.. transition)."""
+    s = w[0, tags[0]] + emis[0, tags[0]]
+    for t in range(1, len(tags)):
+        s += w[2 + tags[t - 1], tags[t]] + emis[t, tags[t]]
+    s += w[1, tags[-1]]
+    return s
+
+
+def _np_nll(emis, T, labels, w, K):
+    e = emis[:T].astype(np.float64)
+    gold = _path_score(e, labels[:T], w.astype(np.float64), K)
+    scores = [
+        _path_score(e, tags, w.astype(np.float64), K)
+        for tags in itertools.product(range(K), repeat=T)
+    ]
+    m = max(scores)
+    logz = m + np.log(sum(np.exp(s - m) for s in scores))
+    return logz - gold
+
+
+def _data():
+    rng = np.random.RandomState(1)
+    K = 3
+    lens = [3, 2]
+    emis = pack_sequences([rng.randn(T, K).astype("float32") for T in lens])
+    labels = pack_sequences(
+        [rng.randint(0, K, size=(T,)).astype("int64") for T in lens]
+    )
+    return emis, labels, lens, K
+
+
+def _build(v):
+    return fluid.layers.linear_chain_crf(
+        input=v["x"], label=v["y"], param_attr=fluid.ParamAttr(name="crfw")
+    )
+
+
+def test_crf_nll_matches_bruteforce():
+    emis, labels, lens, K = _data()
+    h = OpHarness(_build, {"x": emis, "y": labels})
+    (nll,) = h.outputs()
+    w = np.asarray(h.scope.vars["crfw"])
+    want = np.array([
+        [_np_nll(emis.data[b], lens[b], labels.data[b], w, K)]
+        for b in range(len(lens))
+    ])
+    np.testing.assert_allclose(np.asarray(nll), want, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_grads_vs_fd():
+    emis, labels, _, _ = _data()
+    check_grad(_build, {"x": emis, "y": labels}, ["x", "crfw"], rtol=2e-2, atol=5e-3)

@@ -1,0 +1,53 @@
+"""roi_pool: forward vs a per-cell NumPy max, grad vs FD (reference:
+test_roi_pool_op.py; kernel operators/roi_pool_op.*)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+
+def _np_roi_pool(x, rois, ph, pw, scale):
+    """x [1, C, H, W]; rois [R, 4] all on image 0 (batch via lengths is
+    covered by the detection e2e tests)."""
+    _, C, H, W = x.shape
+    out = np.zeros((len(rois), C, ph, pw), x.dtype)
+    for r, (x1, y1, x2, y2) in enumerate(rois):
+        x1, y1 = int(round(x1 * scale)), int(round(y1 * scale))
+        x2, y2 = int(round(x2 * scale)), int(round(y2 * scale))
+        rw, rh = max(x2 - x1 + 1, 1), max(y2 - y1 + 1, 1)
+        for i in range(ph):
+            hs = y1 + int(np.floor(i * rh / ph))
+            he = y1 + int(np.ceil((i + 1) * rh / ph))
+            for j in range(pw):
+                ws = x1 + int(np.floor(j * rw / pw))
+                we = x1 + int(np.ceil((j + 1) * rw / pw))
+                hs_, he_ = min(max(hs, 0), H), min(max(he, 0), H)
+                ws_, we_ = min(max(ws, 0), W), min(max(we, 0), W)
+                patch = x[0, :, hs_:he_, ws_:we_]
+                out[r, :, i, j] = (
+                    patch.reshape(C, -1).max(-1) if patch.size else 0.0
+                )
+    return out
+
+
+def _build(v):
+    return fluid.layers.roi_pool(
+        input=v["x"], rois=v["rois"], pooled_height=2, pooled_width=2,
+        spatial_scale=0.5,
+    )
+
+
+def test_roi_pool_forward():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 7, 7], [2, 2, 10, 10], [4, 0, 6, 3]], "float32")
+    want = _np_roi_pool(x, rois, 2, 2, 0.5)
+    check_output(_build, {"x": x, "rois": rois}, want, rtol=1e-5)
+
+
+def test_roi_pool_grad_vs_fd():
+    rng = np.random.RandomState(1)
+    # distinct values so the max is unique -> differentiable sample points
+    x = (rng.permutation(3 * 8 * 8).reshape(1, 3, 8, 8) * 0.1).astype("float32")
+    rois = np.array([[0, 0, 7, 7], [2, 2, 10, 10]], "float32")
+    check_grad(_build, {"x": x, "rois": rois}, ["x"], rtol=2e-2, atol=2e-3)

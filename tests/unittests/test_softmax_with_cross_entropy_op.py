@@ -1,0 +1,40 @@
+"""softmax_with_cross_entropy: forward vs numpy log-softmax, grad vs FD
+(reference: test_softmax_with_cross_entropy_op.py; kernel
+operators/softmax_with_cross_entropy_op.*)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+
+def _np_ref(logits, labels, soft=False):
+    m = logits - logits.max(-1, keepdims=True)
+    logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+    if soft:
+        return -(labels * logp).sum(-1, keepdims=True)
+    return -np.take_along_axis(logp, labels, axis=-1)
+
+
+def test_hard_label_forward_and_grad():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(6, 10).astype("float32")
+    labels = rng.randint(0, 10, size=(6, 1)).astype("int64")
+
+    def build(v):
+        return fluid.layers.softmax_with_cross_entropy(v["logits"], v["labels"])
+
+    inputs = {"logits": logits, "labels": labels}
+    check_output(build, inputs, _np_ref(logits, labels), rtol=1e-5)
+    check_grad(build, inputs, ["logits"])
+
+
+def test_soft_label_forward():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(5, 8).astype("float32")
+    raw = rng.rand(5, 8).astype("float32")
+    soft = raw / raw.sum(-1, keepdims=True)
+
+    def build(v):
+        return fluid.layers.softmax_with_cross_entropy(v["logits"], v["soft"], soft_label=True)
+
+    check_output(build, {"logits": logits, "soft": soft}, _np_ref(logits, soft, soft=True), rtol=1e-5)

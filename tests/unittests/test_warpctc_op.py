@@ -1,0 +1,72 @@
+"""warpctc: forward vs a NumPy alpha-recursion CTC reference, gradient vs
+finite differences (reference: test_warpctc_op.py; kernel
+operators/warpctc_op.* wrapping warp-ctc)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import check_grad, check_output
+
+
+def _np_ctc_loss(logits, T, labels, L, blank=0):
+    """Forward algorithm on the extended label sequence, log domain."""
+    logp = logits[:T].astype(np.float64)
+    logp = logp - logp.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    lab = labels[:L]
+    ext = np.full(2 * L + 1, blank, np.int64)
+    ext[1::2] = lab
+    S = len(ext)
+    NEG = -1e30
+    alpha = np.full(S, NEG)
+    alpha[0] = logp[0, ext[0]]
+    if S > 1:
+        alpha[1] = logp[0, ext[1]]
+
+    def logadd(a, b):
+        m = np.maximum(a, b)
+        return m + np.log(np.exp(a - m) + np.exp(b - m))
+
+    for t in range(1, T):
+        prev = alpha.copy()
+        for s in range(S):
+            val = prev[s]
+            if s >= 1:
+                val = logadd(val, prev[s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                val = logadd(val, prev[s - 2])
+            alpha[s] = val + logp[t, ext[s]]
+    total = alpha[S - 1]
+    if S > 1:
+        total = logadd(total, alpha[S - 2])
+    return -total
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    C = 5  # classes incl. blank 0
+    logit_lens = [6, 4]
+    label_lens = [2, 2]
+    logits = pack_sequences([rng.randn(T, C).astype("float32") for T in logit_lens])
+    labels = pack_sequences(
+        [rng.randint(1, C, size=(L,)).astype("int64") for L in label_lens]
+    )
+    return logits, labels, logit_lens, label_lens
+
+
+def _build(v):
+    return fluid.layers.warpctc(input=v["x"], label=v["y"], blank=0)
+
+
+def test_warpctc_forward_matches_numpy_dp():
+    logits, labels, tlens, llens = _data()
+    want = np.array([
+        [_np_ctc_loss(logits.data[b], tlens[b], labels.data[b], llens[b])]
+        for b in range(len(tlens))
+    ])
+    check_output(_build, {"x": logits, "y": labels}, want, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_grad_vs_fd():
+    logits, labels, _, _ = _data()
+    check_grad(_build, {"x": logits, "y": labels}, ["x"], rtol=2e-2, atol=5e-3)
