@@ -26,7 +26,9 @@ _ACT_NOATTR = [
     "sign",
 ]
 
-__all__ = list(_ACT_NOATTR) + ["uniform_random", "hard_shrink", "cumsum", "thresholded_relu", "maxout"]
+__all__ = list(_ACT_NOATTR) + [
+    "uniform_random", "hard_shrink", "softshrink", "cumsum", "thresholded_relu", "maxout",
+]
 
 
 def _make_act(op_type):
@@ -65,6 +67,10 @@ def _attr_act(op_type, x, name=None, **attrs):
 
 def hard_shrink(x, threshold=0.5):
     return _attr_act("hard_shrink", x, threshold=threshold)
+
+
+def softshrink(x, alpha=0.5):
+    return _attr_act("softshrink", x, **{"lambda": alpha})
 
 
 def thresholded_relu(x, threshold=1.0):

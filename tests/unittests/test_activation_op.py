@@ -1,0 +1,136 @@
+import zlib
+"""Activation zoo: forward vs numpy and grad vs FD for every smooth
+activation; kinked ones (relu family, abs) use inputs bounded away from
+the kink (reference: test_activation_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+_SMOOTH = {
+    "sigmoid": (lambda v: L.sigmoid(v["x"]), lambda x: 1 / (1 + np.exp(-x))),
+    "logsigmoid": (lambda v: L.logsigmoid(v["x"]), lambda x: -np.log1p(np.exp(-x))),
+    "exp": (lambda v: L.exp(v["x"]), np.exp),
+    "tanh": (lambda v: L.tanh(v["x"]), np.tanh),
+    "tanh_shrink": (lambda v: L.tanh_shrink(v["x"]), lambda x: x - np.tanh(x)),
+    "softplus": (lambda v: L.softplus(v["x"]), lambda x: np.log1p(np.exp(x))),
+    "softsign": (lambda v: L.softsign(v["x"]), lambda x: x / (1 + np.abs(x))),
+    "cos": (lambda v: L.cos(v["x"]), np.cos),
+    "sin": (lambda v: L.sin(v["x"]), np.sin),
+    "square": (lambda v: L.square(v["x"]), np.square),
+    "reciprocal": (lambda v: L.reciprocal(v["x"]), lambda x: 1 / x),
+    "stanh": (lambda v: L.stanh(v["x"], scale_a=0.67, scale_b=1.7159),
+              lambda x: 1.7159 * np.tanh(0.67 * x)),
+    "swish": (lambda v: L.swish(v["x"]), lambda x: x / (1 + np.exp(-x))),
+    "elu": (lambda v: L.elu(v["x"], alpha=0.8),
+            lambda x: np.where(x > 0, x, 0.8 * (np.exp(x) - 1))),
+    "soft_relu": (lambda v: L.soft_relu(v["x"], threshold=40.0),
+                  lambda x: np.log1p(np.exp(x))),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_SMOOTH))
+def test_smooth_activation(name):
+    build, ref = _SMOOTH[name]
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
+    x = rng.uniform(0.3, 2.0, size=(3, 5)).astype("float32")  # positive & away from poles
+    check_output(build, {"x": x}, ref(x.astype(np.float64)), rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+_KINKED = {
+    "relu": (lambda v: L.relu(v["x"]), lambda x: np.maximum(x, 0)),
+    "abs": (lambda v: L.abs(v["x"]), np.abs),
+    "relu6": (lambda v: L.relu6(v["x"]), lambda x: np.clip(x, 0, 6)),
+    "leaky_relu": (lambda v: L.leaky_relu(v["x"], alpha=0.1),
+                   lambda x: np.where(x > 0, x, 0.1 * x)),
+    "brelu": (lambda v: L.brelu(v["x"], t_min=-1.0, t_max=1.5),
+              lambda x: np.clip(x, -1.0, 1.5)),
+    "hard_sigmoid": (lambda v: L.hard_sigmoid(v["x"], slope=0.2, offset=0.5),
+                     lambda x: np.clip(0.2 * x + 0.5, 0, 1)),
+    "softshrink": (lambda v: L.softshrink(v["x"], alpha=0.5),
+                   lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0)),
+    "hard_shrink": (lambda v: L.hard_shrink(v["x"], threshold=0.5),
+                    lambda x: np.where(np.abs(x) > 0.5, x, 0)),
+    "thresholded_relu": (lambda v: L.thresholded_relu(v["x"], threshold=1.0),
+                         lambda x: np.where(x > 1.0, x, 0)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_KINKED))
+def test_kinked_activation(name):
+    build, ref = _KINKED[name]
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
+    # sample away from every kink in {-1, -0.5, 0, 0.5, 1, 1.5, 6}
+    x = rng.choice([-2.2, -0.75, -0.25, 0.25, 0.75, 2.2, 6.6], size=(4, 6))
+    x = (x + rng.uniform(-0.05, 0.05, size=x.shape)).astype("float32")
+    check_output(build, {"x": x}, ref(x.astype(np.float64)), rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+_ROUNDING = {
+    "ceil": (lambda v: L.ceil(v["x"]), np.ceil),
+    "floor": (lambda v: L.floor(v["x"]), np.floor),
+    "round": (lambda v: L.round(v["x"]), np.round),
+    "sign": (lambda v: L.sign(v["x"]), np.sign),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_ROUNDING))
+def test_rounding_activation_forward(name):
+    build, ref = _ROUNDING[name]
+    rng = np.random.RandomState(3)
+    x = (rng.randn(3, 7) * 3).astype("float32")
+    check_output(build, {"x": x}, ref(x.astype(np.float64)), rtol=1e-6, atol=1e-6)
+
+
+def test_sqrt_rsqrt_log_pow():
+    rng = np.random.RandomState(4)
+    x = rng.uniform(0.5, 4.0, size=(3, 5)).astype("float32")
+    check_output(lambda v: L.sqrt(v["x"]), {"x": x}, np.sqrt(x), rtol=1e-5)
+    check_grad(lambda v: L.sqrt(v["x"]), {"x": x}, ["x"])
+    check_output(lambda v: L.rsqrt(v["x"]), {"x": x}, 1 / np.sqrt(x), rtol=1e-5)
+    check_output(lambda v: L.log(v["x"]), {"x": x}, np.log(x), rtol=1e-5)
+    check_grad(lambda v: L.log(v["x"]), {"x": x}, ["x"])
+    check_output(lambda v: L.pow(v["x"], factor=2.5), {"x": x}, x ** 2.5, rtol=1e-4)
+    check_grad(lambda v: L.pow(v["x"], factor=2.5), {"x": x}, ["x"])
+
+
+def test_prelu_channelwise():
+    rng = np.random.RandomState(5)
+    x = rng.choice([-1.5, -0.5, 0.5, 1.5], size=(2, 3, 4)).astype("float32")
+
+    def build(v):
+        return L.prelu(v["x"], mode="channel",
+                       param_attr=fluid.ParamAttr(name="prelu_alpha"))
+
+    from op_test import OpHarness
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    alpha = np.asarray(h.scope.vars["prelu_alpha"]).reshape(1, 3, 1)
+    np.testing.assert_allclose(got, np.where(x > 0, x, alpha * x), rtol=1e-5)
+    check_grad(build, {"x": x}, ["x", "prelu_alpha"])
+
+
+def test_maxout():
+    rng = np.random.RandomState(6)
+    # distinct, well-separated values: FD must not straddle the pairwise max tie
+    x = (rng.permutation(2 * 6 * 3 * 3).reshape(2, 6, 3, 3) * 0.11).astype("float32")
+
+    def build(v):
+        return L.maxout(v["x"], groups=2)
+
+    want = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    check_output(build, {"x": x}, want, rtol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_cumsum():
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 5).astype("float32")
+    check_output(lambda v: L.cumsum(v["x"], axis=1), {"x": x}, np.cumsum(x, 1), rtol=1e-5)
+    check_grad(lambda v: L.cumsum(v["x"], axis=1), {"x": x}, ["x"])

@@ -1,0 +1,71 @@
+"""concat / split / stack / unstack / sum (n-ary add) / sums — forward vs
+numpy + grads (reference: test_concat_op.py, test_split_op.py,
+test_stack_op.py, test_sum_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_concat():
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3).astype("float32")
+    b = rng.randn(2, 5).astype("float32")
+
+    def build(v):
+        return L.concat([v["a"], v["b"]], axis=1)
+
+    check_output(build, {"a": a, "b": b}, np.concatenate([a, b], 1), rtol=1e-6)
+    check_grad(build, {"a": a, "b": b}, ["a", "b"])
+
+
+def test_split_even_and_sections():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 6).astype("float32")
+
+    def build(v):
+        return L.split(v["x"], num_or_sections=3, dim=1)
+
+    check_output(build, {"x": x}, np.split(x, 3, 1), rtol=1e-6)
+
+    def build2(v):
+        return L.split(v["x"], num_or_sections=[2, 4], dim=1)
+
+    check_output(build2, {"x": x}, [x[:, :2], x[:, 2:]], rtol=1e-6)
+
+
+def test_stack_unstack():
+    rng = np.random.RandomState(2)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3, 4).astype("float32")
+
+    def build(v):
+        return L.stack([v["a"], v["b"]], axis=1)
+
+    check_output(build, {"a": a, "b": b}, np.stack([a, b], 1), rtol=1e-6)
+    check_grad(build, {"a": a, "b": b}, ["a", "b"])
+
+    x = rng.randn(3, 2, 4).astype("float32")
+
+    def build_u(v):
+        return L.unstack(v["x"], axis=1)
+
+    check_output(build_u, {"x": x}, [x[:, 0], x[:, 1]], rtol=1e-6)
+
+
+def test_sum_nary():
+    rng = np.random.RandomState(3)
+    arrs = {k: rng.randn(2, 3).astype("float32") for k in "abc"}
+
+    def build(v):
+        return L.sum([v["a"], v["b"], v["c"]])
+
+    check_output(build, arrs, arrs["a"] + arrs["b"] + arrs["c"], rtol=1e-6)
+    check_grad(build, arrs, ["a", "b", "c"])
+
+    def build_sums(v):
+        return L.sums([v["a"], v["b"], v["c"]])
+
+    check_output(build_sums, arrs, arrs["a"] + arrs["b"] + arrs["c"], rtol=1e-6)

@@ -1,0 +1,82 @@
+"""conv2d: forward vs a direct NumPy convolution (strides/pads/dilation/
+groups), grads for input and filter vs FD (reference: test_conv2d_op.py;
+kernel operators/conv_op.* + cuDNN variant)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad
+
+
+def _np_conv2d(x, w, stride, pad, dil=1, groups=1):
+    N, C, H, W = x.shape
+    M, Cg, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    kh_e, kw_e = (kh - 1) * dil + 1, (kw - 1) * dil + 1
+    Ho = (H + 2 * pad - kh_e) // stride + 1
+    Wo = (W + 2 * pad - kw_e) // stride + 1
+    out = np.zeros((N, M, Ho, Wo), np.float64)
+    mg = M // groups
+    for n in range(N):
+        for m in range(M):
+            g = m // mg
+            for i in range(Ho):
+                for j in range(Wo):
+                    patch = xp[n, g * Cg:(g + 1) * Cg,
+                               i * stride:i * stride + kh_e:dil,
+                               j * stride:j * stride + kw_e:dil]
+                    out[n, m, i, j] = (patch * w[m]).sum()
+    return out
+
+
+@pytest.mark.parametrize("stride,pad,dil,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 1, 2, 1), (1, 1, 1, 2),
+])
+def test_conv2d_forward(stride, pad, dil, groups):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 7, 7).astype("float32")
+
+    def build(v):
+        return fluid.layers.conv2d(
+            v["x"], num_filters=6, filter_size=3, stride=stride, padding=pad,
+            dilation=dil, groups=groups,
+            param_attr=fluid.ParamAttr(name="conv_w"), bias_attr=False,
+        )
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["conv_w"])
+    want = _np_conv2d(x, w, stride, pad, dil, groups)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grads():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+
+    def build(v):
+        return fluid.layers.conv2d(
+            v["x"], num_filters=4, filter_size=3, stride=2, padding=1,
+            param_attr=fluid.ParamAttr(name="conv_w"),
+            bias_attr=fluid.ParamAttr(name="conv_b"),
+        )
+
+    check_grad(build, {"x": x}, ["x", "conv_w", "conv_b"], rtol=2e-2, atol=2e-3)
+
+
+def test_depthwise_conv2d():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+
+    def build(v):
+        return fluid.layers.conv2d(
+            v["x"], num_filters=3, filter_size=3, groups=3, padding=1,
+            param_attr=fluid.ParamAttr(name="dw_w"), bias_attr=False,
+            use_cudnn=False,
+        )
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["dw_w"])
+    want = _np_conv2d(x, w, 1, 1, 1, groups=3)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,53 @@
+"""cross_entropy on probabilities (hard/soft label, ignore_index) —
+reference: test_cross_entropy_op.py."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+
+def _softmaxed(rng, n, c):
+    raw = rng.rand(n, c).astype("float32") + 0.5  # p bounded away from 0: -log(p) curvature vs FD
+    return raw / raw.sum(-1, keepdims=True)
+
+
+def test_hard_label():
+    rng = np.random.RandomState(0)
+    p = _softmaxed(rng, 5, 7)
+    labels = rng.randint(0, 7, size=(5, 1)).astype("int64")
+
+    def build(v):
+        return fluid.layers.cross_entropy(v["p"], v["y"])
+
+    want = -np.log(np.take_along_axis(p, labels, axis=1))
+    check_output(build, {"p": p, "y": labels}, want, rtol=1e-5)
+    check_grad(build, {"p": p, "y": labels}, ["p"], eps=2e-3)
+
+
+def test_soft_label():
+    rng = np.random.RandomState(1)
+    p = _softmaxed(rng, 4, 6)
+    soft = _softmaxed(rng, 4, 6)
+
+    def build(v):
+        return fluid.layers.cross_entropy(v["p"], v["soft"], soft_label=True)
+
+    want = -(soft * np.log(p)).sum(-1, keepdims=True)
+    check_output(build, {"p": p, "soft": soft}, want, rtol=1e-5)
+    check_grad(build, {"p": p, "soft": soft}, ["p"], eps=2e-3)
+
+
+def test_ignore_index():
+    rng = np.random.RandomState(2)
+    p = _softmaxed(rng, 6, 4)
+    labels = rng.randint(0, 4, size=(6, 1)).astype("int64")
+    labels[2, 0] = -100
+    labels[5, 0] = -100
+
+    def build(v):
+        return fluid.layers.cross_entropy(v["p"], v["y"], ignore_index=-100)
+
+    safe = np.where(labels == -100, 0, labels)
+    want = -np.log(np.take_along_axis(p, safe, axis=1))
+    want[labels == -100] = 0.0
+    check_output(build, {"p": p, "y": labels}, want, rtol=1e-5)

@@ -1,0 +1,48 @@
+"""dropout: is_test passthrough, train-mode keep statistics and scaling
+semantics for both implementations (reference: test_dropout_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_output
+
+L = fluid.layers
+
+
+def test_is_test_passthrough():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype("float32")
+
+    def build(v):
+        return L.dropout(v["x"], dropout_prob=0.7, is_test=True)
+
+    # downgrade_in_infer scales by (1 - p) at inference
+    check_output(build, {"x": x}, x * 0.3, rtol=1e-5)
+
+
+def test_upscale_in_train_identity_at_infer():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype("float32")
+
+    def build(v):
+        return L.dropout(v["x"], dropout_prob=0.7, is_test=True,
+                         dropout_implementation="upscale_in_train")
+
+    check_output(build, {"x": x}, x, rtol=1e-5)
+
+
+def test_train_mode_statistics():
+    rng = np.random.RandomState(2)
+    x = np.ones((64, 64), "float32")
+    p = 0.4
+
+    def build(v):
+        return L.dropout(v["x"], dropout_prob=p, is_test=False,
+                         dropout_implementation="upscale_in_train")
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    got = np.asarray(got)
+    kept = got != 0
+    # survivors are upscaled by 1/(1-p); keep rate concentrates near 1-p
+    np.testing.assert_allclose(got[kept], 1.0 / (1 - p), rtol=1e-5)
+    assert abs(kept.mean() - (1 - p)) < 0.03, kept.mean()

@@ -1,0 +1,74 @@
+"""fc (num_flatten_dims, bias, act) and embedding lookup (incl.
+padding_idx and grad scatter-add) — reference: test_fc_op.py,
+test_lookup_table_op.py."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad
+
+
+def test_fc_forward_and_grads():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype("float32")
+
+    def build(v):
+        return fluid.layers.fc(
+            v["x"], size=3,
+            param_attr=fluid.ParamAttr(name="fc_w"),
+            bias_attr=fluid.ParamAttr(name="fc_b"),
+        )
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["fc_w"])
+    b = np.asarray(h.scope.vars["fc_b"])
+    np.testing.assert_allclose(got, x @ w + b, rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x}, ["x", "fc_w", "fc_b"])
+
+
+def test_fc_num_flatten_dims():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4).astype("float32")
+
+    def build(v):
+        return fluid.layers.fc(
+            v["x"], size=5, num_flatten_dims=2,
+            param_attr=fluid.ParamAttr(name="fc2_w"), bias_attr=False,
+        )
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["fc2_w"])
+    np.testing.assert_allclose(got, (x.reshape(6, 4) @ w).reshape(2, 3, 5),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_lookup_and_grad():
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 10, size=(4, 3)).astype("int64")
+
+    def build(v):
+        return fluid.layers.embedding(
+            v["ids"], size=[10, 5], param_attr=fluid.ParamAttr(name="emb_w"))
+
+    h = OpHarness(build, {"ids": ids})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["emb_w"])
+    np.testing.assert_allclose(got, w[ids], rtol=1e-5)
+    check_grad(build, {"ids": ids}, ["emb_w"])
+
+
+def test_embedding_padding_idx_zero_row():
+    rng = np.random.RandomState(3)
+    ids = np.array([[0, 2], [1, 0]], "int64")
+
+    def build(v):
+        return fluid.layers.embedding(
+            v["ids"], size=[4, 3], padding_idx=0,
+            param_attr=fluid.ParamAttr(name="emb_p"))
+
+    h = OpHarness(build, {"ids": ids})
+    (got,) = h.outputs()
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, 0], np.zeros(3), atol=1e-7)
+    np.testing.assert_allclose(got[1, 1], np.zeros(3), atol=1e-7)

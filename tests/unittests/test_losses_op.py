@@ -1,0 +1,102 @@
+"""Pointwise/pairwise losses: square_error_cost, smooth_l1, dice_loss,
+rank_loss, margin_rank_loss, cos_sim, label_smooth — forward vs numpy +
+grads (reference: test_smooth_l1_loss_op.py, test_rank_loss_op.py,
+test_margin_rank_loss_op.py, test_cos_sim_op.py, test_label_smooth_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_square_error_cost():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3).astype("float32")
+    y = rng.randn(4, 3).astype("float32")
+
+    def build(v):
+        return L.square_error_cost(v["x"], v["y"])
+
+    check_output(build, {"x": x, "y": y}, (x - y) ** 2, rtol=1e-5)
+    check_grad(build, {"x": x, "y": y}, ["x", "y"])
+
+
+def test_smooth_l1():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype("float32") * 2
+    y = rng.randn(4, 6).astype("float32") * 2
+
+    def build(v):
+        return L.smooth_l1(v["x"], v["y"], sigma=1.0)
+
+    d = (x - y).astype(np.float64)
+    per = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    check_output(build, {"x": x, "y": y}, per.sum(-1, keepdims=True), rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x, "y": y}, ["x"], rtol=2e-2, atol=3e-3)
+
+
+def test_dice_loss():
+    rng = np.random.RandomState(2)
+    p = rng.rand(4, 5).astype("float32")
+    lab = (rng.rand(4, 5) > 0.5).astype("float32")
+
+    def build(v):
+        return L.dice_loss(v["p"], v["lab"], epsilon=1e-5)
+
+    inter = (p * lab).sum(-1)
+    union = p.sum(-1) + lab.sum(-1)
+    want = (1 - (2 * inter + 1e-5) / (union + 1e-5)).mean(keepdims=True)
+    check_output(build, {"p": p, "lab": lab}, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rank_loss():
+    rng = np.random.RandomState(3)
+    left = rng.randn(5, 1).astype("float32")
+    right = rng.randn(5, 1).astype("float32")
+    label = (rng.rand(5, 1) > 0.5).astype("float32")
+
+    def build(v):
+        return L.rank_loss(v["lab"], v["l"], v["r"])
+
+    d = (left - right).astype(np.float64)
+    want = np.log1p(np.exp(d)) - label * d
+    check_output(build, {"lab": label, "l": left, "r": right}, want, rtol=1e-4, atol=1e-5)
+    check_grad(build, {"lab": label, "l": left, "r": right}, ["l", "r"])
+
+
+def test_margin_rank_loss():
+    rng = np.random.RandomState(4)
+    left = rng.randn(5, 1).astype("float32")
+    right = rng.randn(5, 1).astype("float32")
+    label = np.where(rng.rand(5, 1) > 0.5, 1.0, -1.0).astype("float32")
+
+    def build(v):
+        return L.margin_rank_loss(v["lab"], v["l"], v["r"], margin=0.3)
+
+    want = np.maximum(0, -label * (left - right) + 0.3)
+    check_output(build, {"lab": label, "l": left, "r": right}, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cos_sim():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 6).astype("float32")
+    y = rng.randn(4, 6).astype("float32")
+
+    def build(v):
+        return L.cos_sim(v["x"], v["y"])
+
+    want = (x * y).sum(-1) / (np.linalg.norm(x, axis=-1) * np.linalg.norm(y, axis=-1))
+    check_output(build, {"x": x, "y": y}, want.reshape(-1, 1), rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x, "y": y}, ["x", "y"])
+
+
+def test_label_smooth():
+    rng = np.random.RandomState(6)
+    onehot = np.eye(5, dtype="float32")[rng.randint(0, 5, size=4)]
+
+    def build(v):
+        return L.label_smooth(v["y"], epsilon=0.1)
+
+    want = onehot * 0.9 + 0.1 / 5
+    check_output(build, {"y": onehot}, want, rtol=1e-5)

@@ -1,0 +1,48 @@
+"""matmul (transpose/alpha/batched) and mul (flattened 2-D matmul):
+forward vs numpy, grads vs FD (reference: test_matmul_op.py,
+test_mul_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False), (False, True), (True, True)])
+def test_matmul_2d(tx, ty):
+    rng = np.random.RandomState(0)
+    a = rng.randn(*(4, 3)[:: -1 if tx else 1]).astype("float32")
+    b = rng.randn(*(3, 5)[:: -1 if ty else 1]).astype("float32")
+
+    def build(v):
+        return fluid.layers.matmul(v["a"], v["b"], transpose_x=tx, transpose_y=ty, alpha=0.5)
+
+    want = 0.5 * (a.T if tx else a) @ (b.T if ty else b)
+    check_output(build, {"a": a, "b": b}, want, rtol=1e-5)
+    check_grad(build, {"a": a, "b": b}, ["a", "b"])
+
+
+def test_matmul_batched():
+    rng = np.random.RandomState(1)
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 4, 5).astype("float32")
+
+    def build(v):
+        return fluid.layers.matmul(v["a"], v["b"])
+
+    check_output(build, {"a": a, "b": b}, a @ b, rtol=1e-5)
+    check_grad(build, {"a": a, "b": b}, ["a", "b"])
+
+
+def test_mul_flattening():
+    """mul flattens x after x_num_col_dims and y before y_num_col_dims."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 4).astype("float32")
+    y = rng.randn(12, 5).astype("float32")
+
+    def build(v):
+        return fluid.layers.mul(v["x"], v["y"], x_num_col_dims=1)
+
+    want = x.reshape(2, 12) @ y
+    check_output(build, {"x": x, "y": y}, want.reshape(2, 5), rtol=1e-5)
+    check_grad(build, {"x": x, "y": y}, ["x", "y"])

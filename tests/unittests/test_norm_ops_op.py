@@ -1,0 +1,97 @@
+"""softmax, layer_norm, lrn, l2_normalize, clip, clip_by_norm — forward vs
+numpy + grads (reference: test_softmax_op.py, test_layer_norm_op.py,
+test_lrn_op.py, test_norm_op.py, test_clip_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad, check_output
+
+L = fluid.layers
+
+
+def test_softmax():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 7).astype("float32")
+
+    def build(v):
+        return L.softmax(v["x"])
+
+    e = np.exp(x - x.max(-1, keepdims=True))
+    check_output(build, {"x": x}, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_layer_norm():
+    rng = np.random.RandomState(1)
+    x = (rng.randn(3, 4, 5) * 2 + 1).astype("float32")
+
+    def build(v):
+        return L.layer_norm(
+            v["x"], begin_norm_axis=1,
+            param_attr=fluid.ParamAttr(name="ln_s"),
+            bias_attr=fluid.ParamAttr(name="ln_b"),
+        )
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    s = np.asarray(h.scope.vars["ln_s"]).reshape(4, 5)
+    b = np.asarray(h.scope.vars["ln_b"]).reshape(4, 5)
+    flat = x.reshape(3, -1).astype(np.float64)
+    mu = flat.mean(-1, keepdims=True)
+    var = flat.var(-1, keepdims=True)
+    norm = ((flat - mu) / np.sqrt(var + 1e-5)).reshape(3, 4, 5)
+    np.testing.assert_allclose(got, norm * s + b, rtol=1e-4, atol=1e-4)
+    check_grad(build, {"x": x}, ["x", "ln_s", "ln_b"], rtol=2e-2, atol=3e-3)
+
+
+def test_lrn():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 6, 4, 4).astype("float32")
+
+    def build(v):
+        return L.lrn(v["x"], n=5, k=1.0, alpha=1e-2, beta=0.75)
+
+    C = 6
+    sq = np.zeros_like(x, np.float64)
+    for c in range(C):
+        lo, hi = max(0, c - 2), min(C, c + 3)
+        sq[:, c] = (x[:, lo:hi].astype(np.float64) ** 2).sum(1)
+    want = x / (1.0 + 1e-2 * sq) ** 0.75
+    check_output(build, {"x": x}, want, rtol=1e-4, atol=1e-5)
+
+
+def test_l2_normalize():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 5).astype("float32")
+
+    def build(v):
+        return L.l2_normalize(v["x"], axis=1)
+
+    want = x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-12)
+    check_output(build, {"x": x}, want, rtol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_clip():
+    rng = np.random.RandomState(4)
+    x = (rng.randn(4, 5) * 2).astype("float32")
+    # keep samples off the clip boundaries for clean FD
+    x = np.where(np.abs(np.abs(x) - 1.0) < 0.05, x * 1.2, x).astype("float32")
+
+    def build(v):
+        return L.clip(v["x"], min=-1.0, max=1.0)
+
+    check_output(build, {"x": x}, np.clip(x, -1, 1), rtol=1e-6)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_clip_by_norm():
+    rng = np.random.RandomState(5)
+    x = (rng.randn(3, 4) * 3).astype("float32")
+
+    def build(v):
+        return L.clip_by_norm(v["x"], max_norm=2.0)
+
+    n = np.linalg.norm(x)
+    want = x * (2.0 / n) if n > 2.0 else x
+    check_output(build, {"x": x}, want, rtol=1e-5)

@@ -1,0 +1,51 @@
+"""one_hot, im2sequence, scale, sign-related creation ops (reference:
+test_one_hot_op.py, test_im2sequence_op.py, test_scale_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_one_hot():
+    ids = np.array([[1], [0], [3]], "int64")
+
+    def build(v):
+        return L.one_hot(v["ids"], depth=4)
+
+    want = np.eye(4, dtype="float32")[ids[:, 0]]
+    check_output(build, {"ids": ids}, want, rtol=0)
+
+
+def test_scale_bias_order():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype("float32")
+
+    def build_after(v):
+        return L.scale(v["x"], scale=2.0, bias=1.0, bias_after_scale=True)
+
+    check_output(build_after, {"x": x}, 2 * x + 1, rtol=1e-6)
+
+    def build_before(v):
+        return L.scale(v["x"], scale=2.0, bias=1.0, bias_after_scale=False)
+
+    check_output(build_before, {"x": x}, 2 * (x + 1), rtol=1e-6)
+    check_grad(build_after, {"x": x}, ["x"])
+
+
+def test_im2sequence():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+
+    def build(v):
+        return L.im2sequence(v["x"], filter_size=2, stride=2)
+
+    # 2x2 patches, stride 2 -> 4 patches/time-steps, each flattened C*kh*kw
+    want = np.zeros((1, 4, 8), "float32")
+    t = 0
+    for i in range(2):
+        for j in range(2):
+            want[0, t] = x[0, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].reshape(-1)
+            t += 1
+    check_output(build, {"x": x}, want, rtol=1e-5)  # [N, T, C*kh*kw] padded layout

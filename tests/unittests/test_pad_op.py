@@ -1,0 +1,51 @@
+"""pad, pad2d (constant/reflect/edge), pad_constant_like — forward + grads
+(reference: test_pad_op.py, test_pad2d_op.py,
+test_pad_constant_like_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_pad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3).astype("float32")
+
+    def build(v):
+        return L.pad(v["x"], paddings=[1, 0, 2, 1], pad_value=0.5)
+
+    want = np.pad(x, ((1, 0), (2, 1)), constant_values=0.5)
+    check_output(build, {"x": x}, want, rtol=1e-6)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_pad2d_modes():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 3, 4).astype("float32")
+    pads = [1, 1, 2, 0]  # top bottom left right
+
+    def pad2d(mode):
+        def build(v):
+            return L.pad2d(v["x"], paddings=pads, mode=mode, pad_value=0.25)
+        return build
+
+    spec = ((0, 0), (0, 0), (1, 1), (2, 0))
+    check_output(pad2d("constant"), {"x": x},
+                 np.pad(x, spec, constant_values=0.25), rtol=1e-6)
+    check_output(pad2d("reflect"), {"x": x}, np.pad(x, spec, mode="reflect"), rtol=1e-6)
+    check_output(pad2d("edge"), {"x": x}, np.pad(x, spec, mode="edge"), rtol=1e-6)
+
+
+def test_pad_constant_like():
+    rng = np.random.RandomState(2)
+    big = rng.randn(4, 5).astype("float32")
+    small = rng.randn(2, 3).astype("float32")
+
+    def build(v):
+        return L.pad_constant_like(v["big"], v["small"], pad_value=-1.0)
+
+    want = np.full((4, 5), -1.0, "float32")
+    want[:2, :3] = small
+    check_output(build, {"big": big, "small": small}, want, rtol=1e-6)

@@ -1,0 +1,53 @@
+"""pool2d: max/avg forward vs numpy (padding, exclusive, global), grads vs
+FD (reference: test_pool2d_op.py; kernel operators/pool_op.*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+
+def _np_pool2d(x, k, s, p, ptype, exclusive=True):
+    N, C, H, W = x.shape
+    Ho = (H + 2 * p - k) // s + 1
+    Wo = (W + 2 * p - k) // s + 1
+    out = np.zeros((N, C, Ho, Wo), np.float64)
+    for i in range(Ho):
+        for j in range(Wo):
+            hs, ws = i * s - p, j * s - p
+            he, we = min(hs + k, H), min(ws + k, W)
+            hs, ws = max(hs, 0), max(ws, 0)
+            patch = x[:, :, hs:he, ws:we].astype(np.float64)
+            if ptype == "max":
+                out[:, :, i, j] = patch.max((2, 3))
+            else:
+                denom = (he - hs) * (we - ws) if exclusive else k * k
+                out[:, :, i, j] = patch.sum((2, 3)) / denom
+    return out
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1)])
+def test_pool2d_forward_grad(ptype, k, s, p):
+    rng = np.random.RandomState(0)
+    # distinct values so max has a unique argmax at FD sample points
+    x = (rng.permutation(2 * 3 * 6 * 6).reshape(2, 3, 6, 6) * 0.07).astype("float32")
+
+    def build(v):
+        return fluid.layers.pool2d(
+            v["x"], pool_size=k, pool_type=ptype, pool_stride=s, pool_padding=p)
+
+    check_output(build, {"x": x}, _np_pool2d(x, k, s, p, ptype), rtol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_global_pooling():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 5, 5).astype("float32")
+
+    def build(v):
+        return fluid.layers.pool2d(v["x"], pool_type="avg", global_pooling=True)
+
+    want = x.mean((2, 3), keepdims=True)
+    check_output(build, {"x": x}, want, rtol=1e-5)
+    check_grad(build, {"x": x}, ["x"])

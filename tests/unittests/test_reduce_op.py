@@ -1,0 +1,54 @@
+"""reduce_{sum,mean,max,min,prod}: dims, keep_dim, full reduction; grads
+vs FD (reference: test_reduce_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+_OPS = {
+    "sum": (L.reduce_sum, np.sum),
+    "mean": (L.reduce_mean, np.mean),
+    "max": (L.reduce_max, np.max),
+    "min": (L.reduce_min, np.min),
+    "prod": (L.reduce_prod, np.prod),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_OPS))
+@pytest.mark.parametrize("dim,keep", [(None, False), (1, False), (1, True), ([0, 2], False)])
+def test_reduce_forward(name, dim, keep):
+    layer, ref = _OPS[name]
+    rng = np.random.RandomState(0)
+    x = rng.uniform(0.5, 1.5, size=(2, 3, 4)).astype("float32")  # >0: stable prod
+
+    def build(v):
+        return layer(v["x"], dim=dim, keep_dim=keep)
+
+    axis = tuple(dim) if isinstance(dim, list) else dim
+    want = ref(x.astype(np.float64), axis=axis, keepdims=keep)
+    check_output(build, {"x": x}, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "prod"])
+def test_reduce_grad(name):
+    layer, _ = _OPS[name]
+    rng = np.random.RandomState(1)
+    x = rng.uniform(0.5, 1.5, size=(3, 4)).astype("float32")
+
+    def build(v):
+        return layer(v["x"], dim=1)
+
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_reduce_max_grad_unique_argmax():
+    rng = np.random.RandomState(2)
+    x = (rng.permutation(12).reshape(3, 4) * 0.37).astype("float32")
+
+    def build(v):
+        return L.reduce_max(v["x"], dim=1)
+
+    check_grad(build, {"x": x}, ["x"])

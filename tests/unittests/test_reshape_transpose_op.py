@@ -1,0 +1,70 @@
+"""reshape (-1 inference), squeeze/unsqueeze, transpose, flatten, expand —
+forward vs numpy + grads through the reshuffle (reference:
+test_reshape_op.py, test_transpose_op.py, test_squeeze_op.py,
+test_expand_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_reshape_with_inference():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4).astype("float32")
+
+    def build(v):
+        return L.reshape(v["x"], shape=[2, -1])
+
+    check_output(build, {"x": x}, x.reshape(2, 12), rtol=1e-6)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_squeeze_unsqueeze():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 1, 4, 1).astype("float32")
+
+    def build(v):
+        return L.squeeze(v["x"], axes=[1, 3])
+
+    check_output(build, {"x": x}, x.reshape(3, 4), rtol=1e-6)
+
+    y = rng.randn(3, 4).astype("float32")
+
+    def build_u(v):
+        return L.unsqueeze(v["y"], axes=[0, 2])
+
+    check_output(build_u, {"y": y}, y.reshape(1, 3, 1, 4), rtol=1e-6)
+
+
+def test_transpose_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 4).astype("float32")
+
+    def build(v):
+        return L.transpose(v["x"], perm=[2, 0, 1])
+
+    check_output(build, {"x": x}, x.transpose(2, 0, 1), rtol=1e-6)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_flatten():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+
+    def build(v):
+        return L.flatten(v["x"], axis=2)
+
+    check_output(build, {"x": x}, x.reshape(6, 20), rtol=1e-6)
+
+
+def test_expand_tiling():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 1, 3).astype("float32")
+
+    def build(v):
+        return L.expand(v["x"], expand_times=[1, 4, 2])
+
+    check_output(build, {"x": x}, np.tile(x, (1, 4, 2)), rtol=1e-6)
+    check_grad(build, {"x": x}, ["x"])

@@ -1,0 +1,70 @@
+"""slice, crop, gather, scatter, multiplex — forward vs numpy + grads
+(reference: test_slice_op.py, test_gather_op.py, test_scatter_op.py,
+test_multiplex_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_slice():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5, 6).astype("float32")
+
+    def build(v):
+        return L.slice(v["x"], axes=[1, 2], starts=[1, 0], ends=[3, 4])
+
+    check_output(build, {"x": x}, x[:, 1:3, :4], rtol=1e-6)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_crop():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype("float32")
+
+    def build(v):
+        return L.crop(v["x"], shape=[2, 3], offsets=[1, 2])
+
+    check_output(build, {"x": x}, x[1:3, 2:5], rtol=1e-6)
+
+
+def test_gather_rows_and_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 3).astype("float32")
+    idx = np.array([[4], [0], [4], [2]], "int64")  # repeated row: grad accumulates
+
+    def build(v):
+        return L.gather(v["x"], v["i"])
+
+    check_output(build, {"x": x, "i": idx}, x[idx[:, 0]], rtol=1e-6)
+    check_grad(build, {"x": x, "i": idx}, ["x"])
+
+
+def test_scatter_overwrite():
+    rng = np.random.RandomState(3)
+    x = rng.randn(5, 3).astype("float32")
+    idx = np.array([[1], [3]], "int64")
+    upd = rng.randn(2, 3).astype("float32")
+
+    def build(v):
+        return L.scatter(v["x"], v["i"], v["u"])
+
+    want = x.copy()
+    want[idx[:, 0]] = upd
+    check_output(build, {"x": x, "i": idx, "u": upd}, want, rtol=1e-6)
+    check_grad(build, {"x": x, "i": idx, "u": upd}, ["x", "u"])
+
+
+def test_multiplex():
+    rng = np.random.RandomState(4)
+    a = rng.randn(4, 3).astype("float32")
+    b = rng.randn(4, 3).astype("float32")
+    idx = np.array([[1], [0], [1], [0]], "int32")
+
+    def build(v):
+        return L.multiplex([v["a"], v["b"]], v["i"])
+
+    want = np.where(idx == 1, b, a)
+    check_output(build, {"a": a, "b": b, "i": idx}, want, rtol=1e-6)

@@ -1,0 +1,60 @@
+"""topk, argsort, argmin/argmax, reverse, cast — forward vs numpy
+(reference: test_top_k_op.py, test_argsort_op.py, test_arg_min_max_op.py,
+test_reverse_op.py, test_cast_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_output
+
+L = fluid.layers
+
+
+def test_topk():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype("float32")
+
+    def build(v):
+        vals, idx = L.topk(v["x"], k=3)
+        return [vals, idx]
+
+    order = np.argsort(-x, axis=1)[:, :3]
+    vals = np.take_along_axis(x, order, 1)
+    check_output(build, {"x": x}, [vals, order.astype(np.int64)], rtol=1e-6)
+
+
+def test_argsort():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 6).astype("float32")
+
+    def build(v):
+        s, idx = L.argsort(v["x"], axis=1)
+        return [s, idx]
+
+    idx = np.argsort(x, 1)
+    check_output(build, {"x": x}, [np.sort(x, 1), idx.astype(np.int64)], rtol=1e-6)
+
+
+def test_argmin_argmax():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 5).astype("float32")
+    check_output(lambda v: L.argmax(v["x"], axis=1), {"x": x},
+                 np.argmax(x, 1).astype(np.int64), rtol=0)
+    check_output(lambda v: L.argmin(v["x"], axis=1), {"x": x},
+                 np.argmin(x, 1).astype(np.int64), rtol=0)
+
+
+def test_reverse():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype("float32")
+    check_output(lambda v: L.reverse(v["x"], axis=1), {"x": x}, x[:, ::-1], rtol=1e-6)
+    check_output(lambda v: L.reverse(v["x"], axis=[0, 1]), {"x": x},
+                 x[::-1, ::-1], rtol=1e-6)
+
+
+def test_cast():
+    x = np.array([[1.7, -2.3], [0.2, 5.9]], "float32")
+    check_output(lambda v: L.cast(v["x"], "int32"), {"x": x},
+                 x.astype("int32"), rtol=0)
+    xi = np.array([[1, 0], [3, 2]], "int32")
+    check_output(lambda v: L.cast(v["x"], "float32"), {"x": xi},
+                 xi.astype("float32"), rtol=1e-6)
