@@ -393,24 +393,70 @@ def _sequence_erase(ctx, op):
     ctx.set_lengths(op.outputs["Out"][0], new_lens)
 
 
+def _flat_payload(jnp, x, old_lens):
+    """Valid rows of a padded [B, T, ...] tensor as a flat [B*T, ...] buffer
+    (payload first, zeros after).  A tensor without lengths is already flat."""
+    if old_lens is None:
+        return x
+    B, T = x.shape[:2]
+    tail = tuple(x.shape[2:])
+    prefix = jnp.cumsum(old_lens) - old_lens
+    pos = prefix[:, None] + jnp.arange(T, dtype=old_lens.dtype)[None, :]
+    valid = jnp.arange(T)[None, :] < old_lens[:, None]
+    safe = jnp.where(valid, pos, B * T)  # OOB rows dropped by the scatter
+    flat = jnp.zeros((B * T,) + tail, x.dtype)
+    return flat.at[safe.reshape(-1)].set(x.reshape((-1,) + tail), mode="drop")
+
+
+def _repack(jnp, flat, new_lens, T2):
+    """Re-segment a flat payload into a padded [B2, T2, ...] layout."""
+    tail = tuple(flat.shape[1:])
+    prefix = jnp.cumsum(new_lens) - new_lens
+    pos = prefix[:, None] + jnp.arange(T2, dtype=new_lens.dtype)[None, :]
+    valid = jnp.arange(T2)[None, :] < new_lens[:, None]
+    out = flat[jnp.clip(pos, 0, flat.shape[0] - 1)]
+    return jnp.where(valid.reshape(valid.shape + (1,) * len(tail)), out, 0)
+
+
 @register("lod_reset")
 def _lod_reset(ctx, op):
+    """Re-segment x's flat payload under a new LoD (reference
+    lod_reset_op.h: the data is untouched because the reference stores it
+    flat; the padded layout must physically repack rows)."""
     jnp = _jnp()
     xname = op.inputs["X"][0]
     x = ctx.get(xname)
-    ctx.set_output(op, "Out", x)
+    old_lens = ctx.get_lengths(xname)
+    flat = _flat_payload(jnp, x, old_lens)
     if op.inputs.get("Y"):
         yname = op.inputs["Y"][0]
         ylens = ctx.get_lengths(yname)
+        y = ctx.get(yname)
         if ylens is None:
             # plain-Tensor Y carries LoD *offsets* (reference lod_reset_op.h):
             # lengths are consecutive differences
-            offs = ctx.get(yname).reshape(-1).astype(jnp.int32)
+            offs = y.reshape(-1).astype(jnp.int32)
             ylens = offs[1:] - offs[:-1]
+            T2 = flat.shape[0]  # no static bound available beyond the payload
+        else:
+            T2 = y.shape[1] if y.ndim >= 2 else flat.shape[0]
+        out = _repack(jnp, flat, ylens.astype(jnp.int32), T2)
+        ctx.set_output(op, "Out", out)
         ctx.set_lengths(op.outputs["Out"][0], ylens)
     else:
-        target = op.attrs.get("target_lod", [])
-        lens = np.diff(np.asarray(target, np.int32))
+        # reference lod_reset_op.h: target_lod is an *offset* vector —
+        # starts at 0, ends at the payload row count
+        offs = np.asarray(op.attrs.get("target_lod", []), np.int64)
+        if offs.size < 2 or offs[0] != 0:
+            raise ValueError(
+                "lod_reset target_lod must be offsets starting at 0, got %s" % (offs,))
+        lens = np.diff(offs).astype(np.int32)
+        if old_lens is None and int(offs[-1]) != int(flat.shape[0]):
+            raise ValueError(
+                "lod_reset target_lod ends at %d but X has %d payload rows"
+                % (int(offs[-1]), int(flat.shape[0])))
+        out = _repack(jnp, flat, jnp.asarray(lens), int(lens.max()) if lens.size else 1)
+        ctx.set_output(op, "Out", out)
         ctx.set_lengths(op.outputs["Out"][0], jnp.asarray(lens, jnp.int32))
 
 

@@ -1,0 +1,74 @@
+"""Tensor creation + RNG ops: fill_constant(+batch_size_like), ones/zeros,
+uniform_random / gaussian_random statistics, sampling_id range, isfinite
+family (reference: test_fill_constant_op.py, test_uniform_random_op.py,
+test_gaussian_random_op.py, test_isfinite_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_output
+
+L = fluid.layers
+
+
+def test_fill_constant_and_batch_size_like():
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 3).astype("float32")
+
+    def build(v):
+        c = L.fill_constant(shape=[2, 3], dtype="float32", value=2.5)
+        like = L.fill_constant_batch_size_like(v["x"], shape=[-1, 4],
+                                               dtype="float32", value=-1.0)
+        return [c, like]
+
+    h = OpHarness(build, {"x": x})
+    c, like = h.outputs()
+    np.testing.assert_allclose(np.asarray(c), np.full((2, 3), 2.5), rtol=0)
+    np.testing.assert_allclose(np.asarray(like), np.full((5, 4), -1.0), rtol=0)
+
+
+def test_ones_zeros():
+    def build(v):
+        return [L.ones(shape=[3, 2], dtype="float32"),
+                L.zeros(shape=[4], dtype="int64")]
+
+    h = OpHarness(build, {"x": np.zeros((1, 1), "float32")})
+    ones, zeros = h.outputs()
+    np.testing.assert_array_equal(np.asarray(ones), np.ones((3, 2), "float32"))
+    np.testing.assert_array_equal(np.asarray(zeros), np.zeros(4, "int64"))
+
+
+def test_uniform_random_statistics():
+    def build(v):
+        return L.uniform_random(shape=[2000], min=-2.0, max=3.0, seed=7)
+
+    h = OpHarness(build, {"x": np.zeros((1, 1), "float32")})
+    (u,) = h.outputs()
+    u = np.asarray(u)
+    assert u.min() >= -2.0 and u.max() <= 3.0
+    assert abs(u.mean() - 0.5) < 0.15  # E = (-2+3)/2
+    assert abs(u.std() - np.sqrt(25 / 12)) < 0.15
+
+
+def test_gaussian_random_statistics():
+    def build(v):
+        return L.gaussian_random(shape=[3000], mean=1.0, std=2.0, seed=11)
+
+    h = OpHarness(build, {"x": np.zeros((1, 1), "float32")})
+    (g,) = h.outputs()
+    g = np.asarray(g)
+    assert abs(g.mean() - 1.0) < 0.15
+    assert abs(g.std() - 2.0) < 0.15
+
+
+def test_isfinite_family():
+    x = np.array([[1.0, np.inf], [np.nan, 2.0]], "float32")
+    ok = np.array([[0.0, 1.0], [3.0, 2.0]], "float32")
+
+    def build(v):
+        return [L.isfinite(v["x"]), L.has_inf(v["x"]), L.has_nan(v["x"]),
+                L.isfinite(v["ok"]), L.has_inf(v["ok"]), L.has_nan(v["ok"])]
+
+    h = OpHarness(build, {"x": x, "ok": ok})
+    fin_x, inf_x, nan_x, fin_ok, inf_ok, nan_ok = (np.ravel(np.asarray(a)) for a in h.outputs())
+    assert not fin_x[0] and inf_x[0] and nan_x[0]
+    assert fin_ok[0] and not inf_ok[0] and not nan_ok[0]

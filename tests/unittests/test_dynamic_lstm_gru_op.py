@@ -1,0 +1,88 @@
+"""dynamic_lstm / dynamic_gru over padded+lengths sequences: forward vs a
+NumPy step loop (gate order {c,i,f,o} resp. {u,r,c}), padding stays zero,
+grads vs FD (reference: test_lstm_op.py, test_gru_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness, check_grad
+
+L = fluid.layers
+
+
+def _sig(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def test_dynamic_lstm_forward_no_peepholes():
+    rng = np.random.RandomState(0)
+    D = 3
+    lens = [4, 2]
+    x = pack_sequences([rng.randn(n, 4 * D).astype("float32") for n in lens])
+
+    def build(v):
+        h, c = L.dynamic_lstm(v["x"], size=4 * D, use_peepholes=False,
+                              param_attr=fluid.ParamAttr(name="dl_w"),
+                              bias_attr=fluid.ParamAttr(name="dl_b"))
+        return [h, c]
+
+    harness = OpHarness(build, {"x": x})
+    got_h, got_c = (np.asarray(a) for a in harness.outputs())
+    w = np.asarray(harness.scope.vars["dl_w"]).astype(np.float64)
+    b = np.asarray(harness.scope.vars["dl_b"]).reshape(-1).astype(np.float64)
+
+    for bi, n in enumerate(lens):
+        h = np.zeros(D)
+        c = np.zeros(D)
+        for t in range(n):
+            g = x.data[bi, t] + h @ w + b
+            g_c, g_i, g_f, g_o = np.split(g, 4)
+            i, f, o = _sig(g_i), _sig(g_f), _sig(g_o)
+            c = f * c + i * np.tanh(g_c)
+            h = o * np.tanh(c)
+            np.testing.assert_allclose(got_h[bi, t], h, rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(got_c[bi, t], c, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(got_h[bi, n:], 0, atol=1e-7)
+
+
+def test_dynamic_lstm_grads():
+    rng = np.random.RandomState(1)
+    D = 2
+    x = pack_sequences([rng.randn(n, 4 * D).astype("float32") for n in [3, 2]])
+
+    def build(v):
+        h, _ = L.dynamic_lstm(v["x"], size=4 * D, use_peepholes=True,
+                              param_attr=fluid.ParamAttr(name="dlg_w"),
+                              bias_attr=fluid.ParamAttr(name="dlg_b"))
+        return h
+
+    check_grad(build, {"x": x}, ["x", "dlg_w"], rtol=2e-2, atol=3e-3)
+
+
+def test_dynamic_gru_forward_and_grad():
+    rng = np.random.RandomState(2)
+    D = 3
+    lens = [3, 5]
+    x = pack_sequences([rng.randn(n, 3 * D).astype("float32") for n in lens])
+
+    def build(v):
+        return L.dynamic_gru(v["x"], size=D,
+                             param_attr=fluid.ParamAttr(name="dg_w"),
+                             bias_attr=fluid.ParamAttr(name="dg_b"))
+
+    harness = OpHarness(build, {"x": x})
+    (got,) = harness.outputs()
+    got = np.asarray(got)
+    w = np.asarray(harness.scope.vars["dg_w"]).astype(np.float64)
+    b = np.asarray(harness.scope.vars["dg_b"]).reshape(-1).astype(np.float64)
+
+    for bi, n in enumerate(lens):
+        h = np.zeros(D)
+        for t in range(n):
+            g = x.data[bi, t] + np.concatenate([h @ w[:, :2 * D], (0 * h)]) * 0  # placeholder
+            g_ur = x.data[bi, t][:2 * D] + h @ w[:, :2 * D] + b[:2 * D]
+            u, r = np.split(_sig(g_ur), 2)
+            cand = np.tanh(x.data[bi, t][2 * D:] + (r * h) @ w[:, 2 * D:] + b[2 * D:])
+            h = (1 - u) * h + u * cand
+            np.testing.assert_allclose(got[bi, t], h, rtol=1e-3, atol=1e-4)
+    check_grad(build, {"x": x}, ["x", "dg_w"], rtol=2e-2, atol=3e-3)

@@ -1,0 +1,93 @@
+"""edit_distance (Levenshtein, normalized + ignored tokens) and ctc_align
+(merge repeats, drop blanks), crf_decoding vs brute-force Viterbi
+(reference: test_edit_distance_op.py, test_ctc_align_op.py,
+test_crf_decoding_op.py)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness
+
+L = fluid.layers
+
+
+def _lev(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1), int)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[-1, -1]
+
+
+def test_edit_distance():
+    hyp = [np.array([1, 2, 3], "int64"), np.array([4, 4], "int64")]
+    ref = [np.array([1, 3, 3, 3], "int64"), np.array([4], "int64")]
+
+    def build(v):
+        d, n = L.edit_distance(v["h"], v["r"], normalized=False)
+        return [d, n]
+
+    h = OpHarness(build, {"h": pack_sequences(hyp), "r": pack_sequences(ref)})
+    d, n = h.outputs()
+    want = np.array([[_lev(a, b)] for a, b in zip(hyp, ref)], "float32")
+    np.testing.assert_allclose(np.asarray(d), want, rtol=1e-6)
+    assert int(np.ravel(np.asarray(n))[0]) == 2
+
+    def build_norm(v):
+        d, n = L.edit_distance(v["h"], v["r"], normalized=True)
+        return [d]
+
+    h2 = OpHarness(build_norm, {"h": pack_sequences(hyp), "r": pack_sequences(ref)})
+    (dn,) = h2.outputs()
+    np.testing.assert_allclose(
+        np.asarray(dn), want / np.array([[4.0], [1.0]]), rtol=1e-6)
+
+
+def test_ctc_greedy_decoder():
+    # frames x classes: argmax path [1,1,0,2,2,0,3] -> merged, blanks dropped: [1,2,3]
+    path = np.array([1, 1, 0, 2, 2, 0, 3])
+    T, C = len(path), 4
+    logits = np.full((T, C), -5.0, "float32")
+    logits[np.arange(T), path] = 5.0
+    x = pack_sequences([logits])
+
+    def build(v):
+        return L.ctc_greedy_decoder(v["x"], blank=0)
+
+    h = OpHarness(build, {"x": x})
+    (out,) = h.outputs()
+    out = np.ravel(np.asarray(out))
+    np.testing.assert_array_equal(out[:3], [1, 2, 3])
+
+
+def test_crf_decoding_matches_bruteforce_viterbi():
+    rng = np.random.RandomState(2)
+    K, T = 3, 4
+    emis = pack_sequences([rng.randn(T, K).astype("float32")])
+    w = (rng.randn(K + 2, K) * 0.7).astype("float32")
+
+    def build(v):
+        crf = L.linear_chain_crf(v["x"], v["y"],
+                                 param_attr=fluid.ParamAttr(name="crfw2"))
+        path = L.crf_decoding(v["x"], param_attr=fluid.ParamAttr(name="crfw2"))
+        return [path]
+
+    labels = pack_sequences([rng.randint(0, K, size=(T,)).astype("int64")])
+    h = OpHarness(build, {"x": emis, "y": labels})
+    h.scope.vars["crfw2"] = w
+    (path,) = h.outputs()
+    path = np.ravel(np.asarray(path))[:T]
+
+    def score(tags):
+        s = w[0, tags[0]] + emis.data[0, 0, tags[0]]
+        for t in range(1, T):
+            s += w[2 + tags[t - 1], tags[t]] + emis.data[0, t, tags[t]]
+        return s + w[1, tags[-1]]
+
+    best = max(itertools.product(range(K), repeat=T), key=score)
+    np.testing.assert_array_equal(path, np.array(best))

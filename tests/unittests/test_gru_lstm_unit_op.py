@@ -1,0 +1,79 @@
+"""gru_unit / lstm_unit single-step recurrent cells: forward vs numpy gate
+math, grads vs FD (reference: test_gru_unit_op.py, test_lstm_unit_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad
+
+L = fluid.layers
+
+
+def _sig(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def test_gru_unit_forward_and_grads():
+    rng = np.random.RandomState(0)
+    B, D = 3, 4
+    xt = rng.randn(B, 3 * D).astype("float32")
+    h = rng.randn(B, D).astype("float32")
+
+    def build(v):
+        new_h, r_h_prev, gate = L.gru_unit(
+            v["x"], v["h"], size=3 * D,
+            param_attr=fluid.ParamAttr(name="gruu_w"), bias_attr=False,
+        )
+        return [new_h, r_h_prev, gate]
+
+    harness = OpHarness(build, {"x": xt, "h": h})
+    new_h, r_h_prev, gate = (np.asarray(a) for a in harness.outputs())
+    w = np.asarray(harness.scope.vars["gruu_w"]).astype(np.float64)
+
+    g_ur = xt[:, :2 * D] + h @ w[:, :2 * D]
+    u, r = np.split(_sig(g_ur), 2, axis=-1)
+    c = np.tanh(xt[:, 2 * D:] + (r * h) @ w[:, 2 * D:])
+    want_h = (1 - u) * h + u * c
+    np.testing.assert_allclose(new_h, want_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(r_h_prev, r * h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gate, np.concatenate([u, r, c], -1), rtol=1e-4, atol=1e-5)
+
+    def build_h(v):
+        return L.gru_unit(v["x"], v["h"], size=3 * D,
+                          param_attr=fluid.ParamAttr(name="gruu_w"),
+                          bias_attr=False)[0]
+
+    check_grad(build_h, {"x": xt, "h": h}, ["x", "h", "gruu_w"], rtol=2e-2, atol=3e-3)
+
+
+def test_lstm_unit_forward_and_grads():
+    rng = np.random.RandomState(1)
+    B, D = 3, 4
+    x = rng.randn(B, D).astype("float32")
+    h_prev = rng.randn(B, D).astype("float32")
+    c_prev = rng.randn(B, D).astype("float32")
+
+    def build(v):
+        h, c = L.lstm_unit(v["x"], v["h"], v["c"], forget_bias=1.0,
+                           param_attr=fluid.ParamAttr(name="lstmu_w"),
+                           bias_attr=fluid.ParamAttr(name="lstmu_b"))
+        return [h, c]
+
+    harness = OpHarness(build, {"x": x, "h": h_prev, "c": c_prev})
+    got_h, got_c = (np.asarray(a) for a in harness.outputs())
+    w = np.asarray(harness.scope.vars["lstmu_w"]).astype(np.float64)
+    b = np.asarray(harness.scope.vars["lstmu_b"]).astype(np.float64)
+
+    gates = np.concatenate([x, h_prev], -1) @ w + b  # [B, 4D], {i,f,o,g}
+    gi, gf, go, gg = np.split(gates, 4, -1)
+    c = _sig(gf + 1.0) * c_prev + _sig(gi) * np.tanh(gg)
+    h = _sig(go) * np.tanh(c)
+    np.testing.assert_allclose(got_c, c, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_h, h, rtol=1e-4, atol=1e-5)
+
+    def build_h(v):
+        return L.lstm_unit(v["x"], v["h"], v["c"], forget_bias=1.0,
+                           param_attr=fluid.ParamAttr(name="lstmu_w"),
+                           bias_attr=fluid.ParamAttr(name="lstmu_b"))[0]
+
+    check_grad(build_h, {"x": x, "h": h_prev, "c": c_prev},
+               ["x", "h", "c", "lstmu_w"], rtol=2e-2, atol=3e-3)

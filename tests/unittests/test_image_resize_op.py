@@ -1,0 +1,74 @@
+"""image_resize / resize_bilinear vs a NumPy bilinear reference, nearest
+mode, and random_crop shape/containment (reference:
+test_bilinear_interp_op.py, test_random_crop_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad, check_output
+
+L = fluid.layers
+
+
+def _np_bilinear(x, Ho, Wo):
+    N, C, H, W = x.shape
+    out = np.zeros((N, C, Ho, Wo), np.float64)
+    sh, sw = H / Ho, W / Wo
+    for i in range(Ho):
+        for j in range(Wo):
+            # align_corners=False convention: pixel-center sampling
+            fy = max((i + 0.5) * sh - 0.5, 0)
+            fx = max((j + 0.5) * sw - 0.5, 0)
+            y0, x0 = int(fy), int(fx)
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            wy, wx = fy - y0, fx - x0
+            out[:, :, i, j] = (
+                x[:, :, y0, x0] * (1 - wy) * (1 - wx)
+                + x[:, :, y1, x0] * wy * (1 - wx)
+                + x[:, :, y0, x1] * (1 - wy) * wx
+                + x[:, :, y1, x1] * wy * wx
+            )
+    return out
+
+
+def test_resize_bilinear_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+
+    def build(v):
+        return L.resize_bilinear(v["x"], out_shape=[8, 6])
+
+    check_output(build, {"x": x}, _np_bilinear(x, 8, 6), rtol=1e-4, atol=1e-4)
+    check_grad(build, {"x": x}, ["x"], rtol=2e-2, atol=3e-3)
+
+
+def test_image_resize_nearest():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+
+    def build(v):
+        return L.image_resize(v["x"], out_shape=[2, 2], resample="NEAREST")
+
+    (got,) = OpHarness(build, {"x": x}).outputs()
+    assert np.asarray(got).shape == (1, 2, 2, 2)
+    # every output pixel is one of the input pixels
+    flat = x.reshape(1, 2, -1)
+    for val in np.asarray(got).reshape(1, 2, -1)[0, 0]:
+        assert np.isclose(flat[0, 0], val).any()
+
+
+def test_random_crop():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+
+    def build(v):
+        return L.random_crop(v["x"], shape=[3, 5, 5])
+
+    (got,) = OpHarness(build, {"x": x}).outputs()
+    got = np.asarray(got)
+    assert got.shape == (2, 3, 5, 5)
+    # crop of the first image appears somewhere in the source
+    found = any(
+        np.allclose(x[0, :, i:i + 5, j:j + 5], got[0])
+        for i in range(4) for j in range(4)
+    )
+    assert found

@@ -1,0 +1,60 @@
+"""In-graph metric ops: accuracy (top-k), auc op, mean_iou — forward vs
+numpy (reference: test_accuracy_op.py, test_auc_op.py,
+test_mean_iou.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_output
+
+L = fluid.layers
+
+
+def test_accuracy_topk():
+    rng = np.random.RandomState(0)
+    probs = rng.rand(8, 5).astype("float32")
+    labels = rng.randint(0, 5, size=(8, 1)).astype("int64")
+
+    def build(v):
+        return L.accuracy(v["p"], v["y"], k=2)
+
+    top2 = np.argsort(-probs, 1)[:, :2]
+    want = np.array([(top2 == labels).any(1).mean()], "float32")
+    check_output(build, {"p": probs, "y": labels}, want, rtol=1e-5)
+
+
+def test_auc_op_matches_rank_formula():
+    rng = np.random.RandomState(1)
+    probs = rng.rand(64, 2).astype("float32")
+    labels = rng.randint(0, 2, size=(64, 1)).astype("int64")
+
+    def build(v):
+        auc_val, states = L.auc(v["p"], v["y"], num_thresholds=4095)
+        return [auc_val]
+
+    h = OpHarness(build, {"p": probs, "y": labels})
+    (got,) = h.outputs()
+    s = probs[:, 1]
+    y = labels[:, 0]
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    npos, nneg = y.sum(), (1 - y).sum()
+    want = (ranks[y == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    np.testing.assert_allclose(float(np.ravel(got)[0]), want, atol=2e-3)
+
+
+def test_mean_iou():
+    pred = np.array([[0, 1, 2, 1], [2, 2, 0, 1]], "int64")
+    lab = np.array([[0, 1, 1, 1], [2, 0, 0, 2]], "int64")
+
+    def build(v):
+        miou, wrong, correct = L.mean_iou(v["p"], v["y"], num_classes=3)
+        return [miou]
+
+    inter = np.zeros(3)
+    union = np.zeros(3)
+    for c in range(3):
+        inter[c] = ((pred == c) & (lab == c)).sum()
+        union[c] = ((pred == c) | (lab == c)).sum()
+    want = np.array((inter / union).mean(), "float32")
+    check_output(build, {"p": pred, "y": lab}, want, rtol=1e-5)

@@ -1,0 +1,56 @@
+"""sequence_conv and sequence_pool gradient checks on the padded+lengths
+layout — padding positions must get exactly zero grad (reference:
+test_sequence_conv_op.py, test_sequence_pool_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness, check_grad
+
+L = fluid.layers
+
+
+def _lod(rng, lens, feat):
+    return pack_sequences([rng.randn(n, feat).astype("float32") for n in lens])
+
+
+def test_sequence_conv_grads():
+    rng = np.random.RandomState(0)
+    x = _lod(rng, [3, 5], 4)
+
+    def build(v):
+        return L.sequence_conv(v["x"], num_filters=3, filter_size=3,
+                               param_attr=fluid.ParamAttr(name="seqconv_w"),
+                               bias_attr=False)
+
+    check_grad(build, {"x": x}, ["x", "seqconv_w"], rtol=2e-2, atol=3e-3)
+
+
+@pytest.mark.parametrize("ptype", ["sum", "average", "sqrt", "max"])
+def test_sequence_pool_grads(ptype):
+    rng = np.random.RandomState(1)
+    lens = [3, 5, 2]
+    if ptype == "max":
+        # unique values: FD needs a stable argmax
+        seqs = [(np.arange(n * 4).reshape(n, 4) * 0.13 + i).astype("float32")
+                for i, n in enumerate(lens)]
+        x = pack_sequences([rng.permutation(s.reshape(-1)).reshape(s.shape) for s in seqs])
+    else:
+        x = _lod(rng, lens, 4)
+
+    def build(v):
+        return L.sequence_pool(v["x"], ptype)
+
+    h = check_grad(build, {"x": x}, ["x"])
+    # grad of every padding slot is exactly zero
+    g = np.asarray(h.analytic_grads()["x"])
+    for b, n in enumerate(lens):
+        np.testing.assert_array_equal(g[b, n:], 0)
+
+
+def test_sequence_first_last_grads():
+    rng = np.random.RandomState(2)
+    x = _lod(rng, [4, 2], 3)
+    check_grad(lambda v: L.sequence_first_step(v["x"]), {"x": x}, ["x"])
+    check_grad(lambda v: L.sequence_last_step(v["x"]), {"x": x}, ["x"])

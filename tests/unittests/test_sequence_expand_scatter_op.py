@@ -1,0 +1,53 @@
+"""sequence_expand / sequence_expand_as / sequence_scatter: forward vs
+numpy on padded+lengths, grads vs FD (reference:
+test_sequence_expand_op.py, test_sequence_scatter_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import check_grad, check_output
+
+L = fluid.layers
+
+
+def test_sequence_expand_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 5).astype("float32")
+    y = pack_sequences([rng.randn(n, 2).astype("float32") for n in [2, 4, 1]])
+
+    def build(v):
+        return L.sequence_expand(v["x"], v["y"])
+
+    check_grad(build, {"x": x, "y": y}, ["x"])
+
+
+def test_sequence_expand_as_forward():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3).astype("float32")
+    y = pack_sequences([rng.randn(n, 1).astype("float32") for n in [3, 2]])
+
+    def build(v):
+        return L.sequence_expand_as(v["x"], v["y"])
+
+    want = np.zeros((2, 3, 3), "float32")
+    want[0, :3] = x[0]
+    want[1, :2] = x[1]
+    check_output(build, {"x": x, "y": y}, want, rtol=1e-6)
+
+
+def test_sequence_scatter_forward_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 6).astype("float32")
+    ids = pack_sequences([np.array([1, 3, 1], "int64"), np.array([0, 5], "int64")])
+    upd = pack_sequences([rng.randn(3).astype("float32"), rng.randn(2).astype("float32")])
+
+    def build(v):
+        return L.sequence_scatter(v["x"], v["ids"], v["upd"])
+
+    want = x.copy()
+    want[0, 1] += upd.data[0, 0] + upd.data[0, 2]  # repeated id accumulates
+    want[0, 3] += upd.data[0, 1]
+    want[1, 0] += upd.data[1, 0]
+    want[1, 5] += upd.data[1, 1]
+    check_output(build, {"x": x, "ids": ids, "upd": upd}, want, rtol=1e-5)
+    check_grad(build, {"x": x, "ids": ids, "upd": upd}, ["x", "upd"])

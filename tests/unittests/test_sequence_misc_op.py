@@ -1,0 +1,98 @@
+"""sequence_mask, sequence_reshape, sequence_enumerate, sequence_concat,
+lod_reset, row_conv — forward references on the padded layout (reference:
+test_sequence_mask_op.py, test_sequence_reshape_op.py,
+test_sequence_enumerate_op.py, test_row_conv_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness, check_grad, check_output
+
+L = fluid.layers
+
+
+def test_sequence_mask():
+    lens = np.array([[3], [1], [4]], "int64")
+
+    def build(v):
+        return L.sequence_mask(v["lens"], maxlen=5, dtype="float32")
+
+    want = (np.arange(5)[None, :] < lens).astype("float32")
+    check_output(build, {"lens": lens}, want, rtol=0)
+
+
+def test_sequence_reshape():
+    rng = np.random.RandomState(0)
+    x = pack_sequences([rng.randn(n, 4).astype("float32") for n in [2, 4]])
+
+    def build(v):
+        return L.sequence_reshape(v["x"], new_dim=8)
+
+    (got,) = OpHarness(build, {"x": x}).outputs()
+    got = np.asarray(got)
+    # per-row dense reshape: each sequence's valid payload stays a prefix
+    np.testing.assert_allclose(got[0, :1], x.data[0, :2].reshape(1, 8), rtol=1e-6)
+    np.testing.assert_allclose(got[1, :2], x.data[1, :4].reshape(2, 8), rtol=1e-6)
+
+
+def test_sequence_enumerate():
+    x = pack_sequences([np.array([1, 2, 3], "int64"), np.array([4, 5], "int64")])
+
+    def build(v):
+        return L.sequence_enumerate(v["x"], win_size=2, pad_value=0)
+
+    (got,) = OpHarness(build, {"x": x}).outputs()
+    got = np.asarray(got)
+    np.testing.assert_array_equal(got[0, :3], [[1, 2], [2, 3], [3, 0]])
+    np.testing.assert_array_equal(got[1, :2], [[4, 5], [5, 0]])
+
+
+def test_sequence_concat():
+    rng = np.random.RandomState(1)
+    a = pack_sequences([rng.randn(2, 3).astype("float32"), rng.randn(1, 3).astype("float32")])
+    b = pack_sequences([rng.randn(1, 3).astype("float32"), rng.randn(2, 3).astype("float32")])
+
+    def build(v):
+        return L.sequence_concat([v["a"], v["b"]])
+
+    (got,) = OpHarness(build, {"a": a, "b": b}).outputs()
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, :3], np.vstack([a.data[0, :2], b.data[0, :1]]), rtol=1e-6)
+    np.testing.assert_allclose(got[1, :3], np.vstack([a.data[1, :1], b.data[1, :2]]), rtol=1e-6)
+
+
+def test_lod_reset():
+    rng = np.random.RandomState(2)
+    x = pack_sequences([rng.randn(2, 3).astype("float32"), rng.randn(4, 3).astype("float32")])
+
+    def build(v):
+        return L.lod_reset(v["x"], target_lod=[0, 3, 6])  # offsets, per reference
+
+    (got,) = OpHarness(build, {"x": x}).outputs()
+    flat = np.vstack([x.data[0, :2], x.data[1, :4]])
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, :3], flat[:3], rtol=1e-6)
+    np.testing.assert_allclose(got[1, :3], flat[3:], rtol=1e-6)
+
+
+def test_row_conv():
+    rng = np.random.RandomState(3)
+    x = pack_sequences([rng.randn(n, 3).astype("float32") for n in [4, 2]])
+
+    def build(v):
+        return L.row_conv(v["x"], future_context_size=2,
+                          param_attr=fluid.ParamAttr(name="rowconv_w"))
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["rowconv_w"])  # [3, D]
+    got = np.asarray(got)
+    for b, n in enumerate([4, 2]):
+        xa = x.data[b, :n]
+        for t in range(n):
+            want = np.zeros(3)
+            for k in range(3):
+                if t + k < n:
+                    want += xa[t + k] * w[k]
+            np.testing.assert_allclose(got[b, t], want, rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x}, ["x", "rowconv_w"])
