@@ -20,9 +20,15 @@ __all__ = [
     "target_assign",
     "detection_output",
     "ssd_loss",
+    "detection_map",
     "iou_similarity",
     "box_coder",
     "anchor_generator",
+    "rpn_target_assign",
+    "generate_proposals",
+    "generate_proposal_labels",
+    "roi_perspective_transform",
+    "polygon_box_transform",
 ]
 
 
@@ -284,3 +290,158 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes.stop_gradient = True
     variances.stop_gradient = True
     return mbox_locs, mbox_confs, boxes, variances
+
+
+def polygon_box_transform(input, name=None):
+    """Per-pixel quad offsets -> absolute coordinates (reference
+    detection.py:373; kernel detection/polygon_box_transform_op.cc)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=input.shape)
+    helper.append_op(
+        type="polygon_box_transform", inputs={"Input": [input]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, name=None):
+    """RPN proposals from anchor deltas (reference detection.py:1463).
+    Static-shape: outputs are [batch, post_nms_top_n, ...] padded, the valid
+    count rides the lengths metadata instead of LoD."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(dtype=bbox_deltas.dtype)
+    probs = helper.create_variable_for_type_inference(dtype=scores.dtype)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors], "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+    )
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var, gt_boxes,
+                      rpn_batch_size_per_im=256, fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=True, name=None):
+    """Sample fg/bg anchors for RPN training (reference detection.py:51).
+    Deterministic top-IoU sampling (use_random accepted for API parity);
+    returns (pred_loc, pred_scores, target_label, target_bbox), each
+    [batch, rpn_batch_size_per_im, ...]."""
+    helper = LayerHelper("rpn_target_assign", name=name)
+    dtype = bbox_pred.dtype
+    loc = helper.create_variable_for_type_inference(dtype=dtype)
+    score = helper.create_variable_for_type_inference(dtype=dtype)
+    label = helper.create_variable_for_type_inference(dtype="int32")
+    tgt = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"BboxPred": [bbox_pred], "ClsLogits": [cls_logits],
+                "AnchorBox": [anchor_box], "AnchorVar": [anchor_var],
+                "GtBoxes": [gt_boxes]},
+        outputs={"PredictedLocation": [loc], "PredictedScores": [score],
+                 "TargetLabel": [label], "TargetBBox": [tgt]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_fg_fraction": fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random},
+    )
+    label.stop_gradient = True
+    tgt.stop_gradient = True
+    return loc, score, label, tgt
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes, im_info=None,
+                             batch_size_per_im=512, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True, name=None):
+    """Sample RoIs + targets for the RCNN head (reference detection.py:1401).
+    Returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights), each [batch, batch_size_per_im, ...]."""
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    dtype = rpn_rois.dtype
+    rois = helper.create_variable_for_type_inference(dtype=dtype)
+    labels = helper.create_variable_for_type_inference(dtype="int32")
+    tgt = helper.create_variable_for_type_inference(dtype=dtype)
+    inw = helper.create_variable_for_type_inference(dtype=dtype)
+    outw = helper.create_variable_for_type_inference(dtype=dtype)
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes], "GtBoxes": [gt_boxes]}
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs=inputs,
+        outputs={"Rois": [rois], "LabelsInt32": [labels], "BboxTargets": [tgt],
+                 "BboxInsideWeights": [inw], "BboxOutsideWeights": [outw]},
+        attrs={"batch_size_per_im": batch_size_per_im, "fg_fraction": fg_fraction,
+               "fg_thresh": fg_thresh, "bg_thresh_hi": bg_thresh_hi,
+               "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums, "use_random": use_random},
+    )
+    for v in (labels, tgt, inw, outw):
+        v.stop_gradient = True
+    return rois, labels, tgt, inw, outw
+
+
+def roi_perspective_transform(input, rois, transformed_height, transformed_width,
+                              spatial_scale=1.0, name=None):
+    """Perspective-warp quadrilateral RoIs ([R, 8] quads) to a fixed
+    rectangle (reference detection.py:1353)."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale},
+    )
+    return out
+
+
+def detection_map(detect_res, label_boxes, label_classes, class_num,
+                  background_label=0, overlap_threshold=0.3,
+                  input_states=None, ap_version="integral",
+                  state_capacity=512, name=None):
+    """Accumulative in-graph mAP (reference detection.py:399).  The padded
+    analog of the reference LoD contract: ``detect_res`` [batch, K, 6]
+    (label, score, x0, y0, x1, y1; invalid rows -1), ground truth as
+    separate boxes [batch, G, 4] + classes [batch, G].
+
+    Returns (map_out, accum_pos_count, accum_true_pos, accum_false_pos);
+    feed the three accum states back through ``input_states`` to pool the
+    metric across batches in-graph.
+    """
+    helper = LayerHelper("detection_map", name=name)
+    map_out = helper.create_variable_for_type_inference(dtype="float32")
+    pc = helper.create_variable_for_type_inference(dtype="int32")
+    tp = helper.create_variable_for_type_inference(dtype="float32")
+    fp = helper.create_variable_for_type_inference(dtype="float32")
+    inputs = {"DetectRes": [detect_res], "GtBoxes": [label_boxes],
+              "GtLabels": [label_classes]}
+    if input_states is not None:
+        inputs["PosCount"] = [input_states[0]]
+        inputs["TruePos"] = [input_states[1]]
+        inputs["FalsePos"] = [input_states[2]]
+    helper.append_op(
+        type="detection_map",
+        inputs=inputs,
+        outputs={"MAP": [map_out], "AccumPosCount": [pc],
+                 "AccumTruePos": [tp], "AccumFalsePos": [fp]},
+        attrs={"class_num": class_num, "background_label": background_label,
+               "overlap_threshold": overlap_threshold, "ap_type": ap_version,
+               "state_capacity": state_capacity},
+    )
+    for v in (map_out, pc, tp, fp):
+        v.stop_gradient = True
+    return map_out, pc, tp, fp

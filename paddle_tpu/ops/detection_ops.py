@@ -465,3 +465,487 @@ def _multiclass_nms(ctx, op):
     name = op.outputs["Out"][0]
     ctx.set_output(op, "Out", outs)  # [B, keep_top_k, 6]
     ctx.set_lengths(name, counts)
+
+
+# ---------------------------------------------------------------------------
+# RPN / Faster-RCNN stack + EAST utilities + in-graph mAP
+# (reference: operators/detection/{generate_proposals,rpn_target_assign,
+#  generate_proposal_labels,roi_perspective_transform,polygon_box_transform}
+#  _op.* and operators/detection_map_op.*) — all static-shape: fixed-K
+#  top-k / sampling with validity masks instead of dynamic tensors.
+# ---------------------------------------------------------------------------
+
+
+@register("polygon_box_transform")
+def _polygon_box_transform(ctx, op):
+    """Per-pixel quad offsets -> absolute coords (polygon_box_transform_op.cc:
+    even channels: x = id_w - in; odd channels: y = id_h - in)."""
+    jnp = _jnp()
+    x = ctx.get_input(op, "Input")  # [B, geo, H, W]
+    B, G, H, W = x.shape
+    jj = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    ii = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(G) % 2 == 0)[None, :, None, None]
+    ctx.set_output(op, "Output", jnp.where(even, jj - x, ii - x))
+
+
+def _clip_boxes(jnp, boxes, h, w):
+    return jnp.stack(
+        [
+            jnp.clip(boxes[..., 0], 0, w - 1),
+            jnp.clip(boxes[..., 1], 0, h - 1),
+            jnp.clip(boxes[..., 2], 0, w - 1),
+            jnp.clip(boxes[..., 3], 0, h - 1),
+        ],
+        axis=-1,
+    )
+
+
+@register("generate_proposals")
+def _generate_proposals(ctx, op):
+    """RPN proposal generation: decode anchor deltas, clip, drop tiny boxes,
+    pre-NMS top-k, greedy NMS, post-NMS top-k (generate_proposals_op.cc),
+    vmapped over the batch with validity lengths instead of LoD."""
+    import jax
+
+    jnp = _jnp()
+    scores = ctx.get_input(op, "Scores")        # [B, A, H, W]
+    deltas = ctx.get_input(op, "BboxDeltas")    # [B, 4A, H, W]
+    im_info = ctx.get_input(op, "ImInfo")       # [B, 3] (h, w, scale)
+    anchors = ctx.get_input(op, "Anchors")      # [H, W, A, 4] or [N, 4]
+    variances = ctx.get_input(op, "Variances")
+    a = op.attrs
+    pre_n = int(a.get("pre_nms_topN", 6000))
+    post_n = int(a.get("post_nms_topN", 1000))
+    nms_thresh = float(a.get("nms_thresh", 0.5))
+    min_size = float(a.get("min_size", 0.1))
+
+    B, A, H, W = scores.shape
+    N = A * H * W
+    anc = anchors.reshape(N, 4)
+    var = variances.reshape(N, 4) if variances is not None else None
+    k1 = min(pre_n, N)
+    k2 = min(post_n, k1)
+
+    # reference BoxCoder for RPN (generate_proposals_op.cc): legacy +1
+    # pixel convention, exp args clamped at log(1000/16) so early-training
+    # deltas can't blow boxes up to e^10 scale
+    bbox_clip = float(np.log(1000.0 / 16.0))
+
+    def decode_rpn(d):
+        aw = anc[:, 2] - anc[:, 0] + 1
+        ah = anc[:, 3] - anc[:, 1] + 1
+        acx = anc[:, 0] + 0.5 * aw
+        acy = anc[:, 1] + 0.5 * ah
+        dv = d * var if var is not None else d
+        cx = dv[:, 0] * aw + acx
+        cy = dv[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(dv[:, 2], bbox_clip)) * aw
+        bh = jnp.exp(jnp.minimum(dv[:, 3], bbox_clip)) * ah
+        return jnp.stack(
+            [cx - bw / 2, cy - bh / 2, cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)
+
+    def per_image(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(N)                   # [H,W,A] order
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(N, 4)
+        boxes = decode_rpn(d)
+        h, w, scale = info[0], info[1], info[2]
+        boxes = _clip_boxes(jnp, boxes, h, w)
+        # FilterBoxes: min_size floored at 1, centers must lie inside the image
+        ms = jnp.maximum(min_size, 1.0) * scale
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        cx = boxes[:, 0] + ws / 2
+        cy = boxes[:, 1] + hs / 2
+        ok = (ws >= ms) & (hs >= ms) & (cx <= w - 1) & (cy <= h - 1)
+        s = jnp.where(ok, s, -jnp.inf)
+        top_s, idx = jax.lax.top_k(s, k1)
+        top_b = boxes[idx]
+        keep = _nms_mask(top_b, top_s, nms_thresh, k1) & (top_s > -jnp.inf)
+        kept_s = jnp.where(keep, top_s, -jnp.inf)
+        out_s, sel = jax.lax.top_k(kept_s, k2)
+        out_b = top_b[sel]
+        valid = out_s > -jnp.inf
+        out_b = jnp.where(valid[:, None], out_b, 0.0)
+        out_s = jnp.where(valid, out_s, 0.0)
+        return out_b, out_s[:, None], valid.astype(jnp.int32).sum()
+
+    rois, probs, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    ctx.set_output(op, "RpnRois", rois)          # [B, post_n, 4]
+    ctx.set_output(op, "RpnRoiProbs", probs)     # [B, post_n, 1]
+    ctx.set_lengths(op.outputs["RpnRois"][0], counts)
+    ctx.set_lengths(op.outputs["RpnRoiProbs"][0], counts)
+
+
+def _topk_mask_indices(jnp, jax, priority, mask, k):
+    """Indices of the up-to-k highest-priority True entries of mask
+    ([N] -> [k] indices + [k] valid flags), deterministic.  k may exceed
+    the pool size; the excess slots come back invalid."""
+    n = priority.shape[0]
+    kk = min(k, n)
+    key = jnp.where(mask, priority, -jnp.inf)
+    val, idx = jax.lax.top_k(key, kk)
+    ok = val > -jnp.inf
+    if kk < k:
+        idx = jnp.concatenate([idx, jnp.zeros(k - kk, idx.dtype)])
+        ok = jnp.concatenate([ok, jnp.zeros(k - kk, bool)])
+    return idx, ok
+
+
+@register("rpn_target_assign")
+def _rpn_target_assign(ctx, op):
+    """Assign fg/bg anchors and emit a fixed-size training sample per image
+    (rpn_target_assign_op.cc semantics, deterministic sampling: highest-IoU
+    foreground and lowest-IoU background anchors first instead of the
+    reference's random subsample)."""
+    import jax
+
+    jnp = _jnp()
+    bbox_pred = ctx.get_input(op, "BboxPred")      # [B, N, 4]
+    cls_logits = ctx.get_input(op, "ClsLogits")    # [B, N, 1]
+    anchors = ctx.get_input(op, "AnchorBox").reshape(-1, 4)   # [N, 4]
+    anchor_var = ctx.get_input(op, "AnchorVar")
+    gt_name = op.inputs["GtBoxes"][0]
+    gt_boxes = ctx.get(gt_name)                    # [B, G, 4]
+    gt_lens = ctx.get_lengths(gt_name)
+    a = op.attrs
+    S = int(a.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(a.get("rpn_fg_fraction", 0.5))
+    pos_ov = float(a.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(a.get("rpn_negative_overlap", 0.3))
+
+    B, G = gt_boxes.shape[:2]
+    N = anchors.shape[0]
+    avar = anchor_var.reshape(-1, 4) if anchor_var is not None else None
+    n_fg = int(S * fg_frac)
+    if gt_lens is None:
+        gt_lens = jnp.full((B,), G, jnp.int32)
+
+    def per_image(pred, logit, gtb, ng):
+        gmask = jnp.arange(G) < ng
+        iou = _iou(anchors, gtb)                     # [N, G]
+        iou = jnp.where(gmask[None, :], iou, -1.0)
+        best = iou.max(axis=1)
+        argbest = iou.argmax(axis=1)
+        fg = best >= pos_ov
+        # every gt's single best anchor is foreground too; padded gt rows
+        # scatter out of bounds and are dropped (a duplicate index 0 write
+        # would clobber anchor 0's flag)
+        best_anchor = jnp.where(gmask, iou.argmax(axis=0), N)
+        fg = fg.at[best_anchor].set(True, mode="drop")
+        bg = (best < neg_ov) & ~fg
+
+        fg_idx, fg_ok = _topk_mask_indices(jnp, jax, best, fg, n_fg)
+        bg_idx, bg_ok = _topk_mask_indices(jnp, jax, -best, bg, S - n_fg)
+        sel = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        is_fg = jnp.concatenate(
+            [jnp.ones(n_fg, bool), jnp.zeros(S - n_fg, bool)]) & ok
+        # prefix-pack valid rows so arange < lengths masking works (stable
+        # sort keeps fg before bg)
+        order = jnp.argsort(~ok, stable=True)
+        sel, ok, is_fg = sel[order], ok[order], is_fg[order]
+
+        tgt_box = _encode_box(anchors[sel],
+                              avar[sel] if avar is not None else None,
+                              gtb[argbest[sel]])
+        tgt_box = jnp.where(is_fg[:, None], tgt_box, 0.0)
+        labels = jnp.where(is_fg, 1, 0).astype(jnp.int32)
+        return pred[sel], logit[sel], labels[:, None], tgt_box, ok.astype(jnp.int32).sum()
+
+    loc_p, score_p, labels, tgt, counts = jax.vmap(per_image)(
+        bbox_pred, cls_logits, gt_boxes, gt_lens)
+    ctx.set_output(op, "PredictedLocation", loc_p)    # [B, S, 4]
+    ctx.set_output(op, "PredictedScores", score_p)    # [B, S, 1]
+    ctx.set_output(op, "TargetLabel", labels)         # [B, S, 1] int32
+    ctx.set_output(op, "TargetBBox", tgt)             # [B, S, 4]
+    for slot in ("PredictedLocation", "PredictedScores", "TargetLabel", "TargetBBox"):
+        ctx.set_lengths(op.outputs[slot][0], counts)
+
+
+@register("generate_proposal_labels")
+def _generate_proposal_labels(ctx, op):
+    """Sample RoIs against ground truth for the RCNN head
+    (generate_proposal_labels_op.cc): gt boxes join the candidate pool,
+    fg = IoU>=fg_thresh (class of best gt), bg = IoU in [lo, hi); fixed
+    batch_size_per_im sample with per-class expanded bbox targets."""
+    import jax
+
+    jnp = _jnp()
+    rois_name = op.inputs["RpnRois"][0]
+    rois = ctx.get(rois_name)                      # [B, R, 4]
+    roi_lens = ctx.get_lengths(rois_name)
+    gt_classes = ctx.get_input(op, "GtClasses")    # [B, G] or [B, G, 1]
+    gtb_name = op.inputs["GtBoxes"][0]
+    gt_boxes = ctx.get(gtb_name)                   # [B, G, 4]
+    gt_lens = ctx.get_lengths(gtb_name)
+    a = op.attrs
+    S = int(a.get("batch_size_per_im", 512))
+    fg_frac = float(a.get("fg_fraction", 0.25))
+    fg_thresh = float(a.get("fg_thresh", 0.5))
+    bg_hi = float(a.get("bg_thresh_hi", 0.5))
+    bg_lo = float(a.get("bg_thresh_lo", 0.0))
+    C = int(a.get("class_nums", 81))
+    weights = a.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+
+    if gt_classes.ndim == 3:
+        gt_classes = gt_classes[..., 0]
+    B, R = rois.shape[:2]
+    G = gt_boxes.shape[1]
+    n_fg = int(S * fg_frac)
+    if roi_lens is None:
+        roi_lens = jnp.full((B,), R, jnp.int32)
+    if gt_lens is None:
+        gt_lens = jnp.full((B,), G, jnp.int32)
+    wvec = jnp.asarray(np.asarray(weights, np.float32))
+
+    def per_image(rs, nroi, gtb, gtc, ng):
+        pool = jnp.concatenate([rs, gtb])                       # [R+G, 4]
+        pmask = jnp.concatenate([jnp.arange(R) < nroi, jnp.arange(G) < ng])
+        gmask = jnp.arange(G) < ng
+        iou = jnp.where(gmask[None, :], _iou(pool, gtb), -1.0)  # [R+G, G]
+        # a valid roi with no (or zero-overlap) gt is background with
+        # max_overlap 0, exactly like the reference — not an invalid row
+        best = jnp.where(pmask, jnp.maximum(iou.max(axis=1), 0.0), -1.0)
+        argbest = iou.argmax(axis=1)
+        fg = best >= fg_thresh
+        bg = (best >= bg_lo) & (best < bg_hi) & pmask
+
+        fg_idx, fg_ok = _topk_mask_indices(jnp, jax, best, fg, n_fg)
+        bg_idx, bg_ok = _topk_mask_indices(jnp, jax, -best, bg, S - n_fg)
+        sel = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        is_fg = jnp.concatenate(
+            [jnp.ones(n_fg, bool), jnp.zeros(S - n_fg, bool)]) & ok
+        # prefix-pack valid rows (stable: fg stays ahead of bg)
+        order = jnp.argsort(~ok, stable=True)
+        sel, ok, is_fg = sel[order], ok[order], is_fg[order]
+
+        out_rois = jnp.where(ok[:, None], pool[sel], 0.0)
+        labels = jnp.where(is_fg, gtc[argbest[sel]], 0).astype(jnp.int32)
+        # encoded regression target to the matched gt, scattered into the
+        # label's 4-wide slot of a [S, 4*C] layout (reference expand form)
+        enc = _encode_box(pool[sel], None, gtb[argbest[sel]]) / wvec
+        tgt = jnp.zeros((S, 4 * C), enc.dtype)
+        col = labels * 4
+        rows = jnp.arange(S)[:, None]
+        cols = col[:, None] + jnp.arange(4)[None, :]
+        vals = jnp.where(is_fg[:, None], enc, 0.0)
+        tgt = tgt.at[rows, cols].set(vals)
+        inside = jnp.zeros((S, 4 * C), enc.dtype).at[rows, cols].set(
+            jnp.where(is_fg[:, None], 1.0, 0.0))
+        return out_rois, labels[:, None], tgt, inside, ok.astype(jnp.int32).sum()
+
+    rois_o, labels_o, tgt_o, inw_o, counts = jax.vmap(per_image)(
+        rois, roi_lens, gt_boxes, gt_classes, gt_lens)
+    ctx.set_output(op, "Rois", rois_o)
+    ctx.set_output(op, "LabelsInt32", labels_o)
+    ctx.set_output(op, "BboxTargets", tgt_o)
+    ctx.set_output(op, "BboxInsideWeights", inw_o)
+    ctx.set_output(op, "BboxOutsideWeights", inw_o)
+    for slot in ("Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights", "BboxOutsideWeights"):
+        ctx.set_lengths(op.outputs[slot][0], counts)
+
+
+@register("roi_perspective_transform")
+def _roi_perspective_transform(ctx, op):
+    """Warp quadrilateral RoIs to a fixed rectangle by per-RoI homography
+    (roi_perspective_transform_op.cc): solve the 8-dof projective mapping
+    rect->quad, then bilinear-sample the source image along it."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.get_input(op, "X")          # [B, C, H, W]
+    rois_name = op.inputs["ROIs"][0]
+    rois = ctx.get(rois_name)           # [R, 8] quad (x1 y1 x2 y2 x3 y3 x4 y4)
+    roi_batch = ctx.get_lengths(rois_name)
+    th = int(op.attrs.get("transformed_height", 8))
+    tw = int(op.attrs.get("transformed_width", 8))
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+
+    B, C, H, W = x.shape
+    R = rois.shape[0]
+    if roi_batch is not None and roi_batch.shape[0] == R:
+        batch_idx = roi_batch.astype(jnp.int32)
+    else:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+
+    # rectangle corners in output space, clockwise from origin
+    rect = jnp.asarray(
+        [[0.0, 0.0], [tw - 1.0, 0.0], [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+
+    def homography(quad):
+        """8x8 solve for H mapping rect -> quad (projective)."""
+        def rows(src, dst):
+            sx, sy = src
+            dx, dy = dst
+            return jnp.asarray([
+                [sx, sy, 1, 0, 0, 0, -dx * sx, -dx * sy],
+                [0, 0, 0, sx, sy, 1, -dy * sx, -dy * sy],
+            ]), jnp.asarray([dx, dy])
+        mats, rhs = zip(*(rows(rect[i], quad[i]) for i in range(4)))
+        Amat = jnp.concatenate(mats)
+        bvec = jnp.concatenate(rhs)
+        h8 = jnp.linalg.solve(Amat, bvec)
+        return jnp.append(h8, 1.0).reshape(3, 3)
+
+    ys = jnp.arange(th, dtype=x.dtype)
+    xs = jnp.arange(tw, dtype=x.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)       # [th, tw]
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones]).reshape(3, -1)   # [3, th*tw]
+
+    def per_roi(quad, b):
+        Hm = homography(quad.reshape(4, 2) * scale)
+        uvw = Hm @ grid
+        u = uvw[0] / uvw[2]
+        v = uvw[1] / uvw[2]
+        x0 = jnp.clip(jnp.floor(u).astype(jnp.int32), 0, W - 1)
+        y0 = jnp.clip(jnp.floor(v).astype(jnp.int32), 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        wx = jnp.clip(u - x0, 0.0, 1.0)
+        wy = jnp.clip(v - y0, 0.0, 1.0)
+        img = x[b]                                   # [C, H, W]
+        out = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+               + img[:, y1, x0] * wy * (1 - wx)
+               + img[:, y0, x1] * (1 - wy) * wx
+               + img[:, y1, x1] * wy * wx)           # [C, th*tw]
+        inb = (u >= 0) & (u <= W - 1) & (v >= 0) & (v <= H - 1)
+        return (out * inb).reshape(C, th, tw)
+
+    out = jax.vmap(per_roi)(rois, batch_idx)
+    ctx.set_output(op, "Out", out)                   # [R, C, th, tw]
+
+
+@register("detection_map")
+def _detection_map(ctx, op):
+    """In-graph accumulative mAP (detection_map_op.h).  State is fixed
+    capacity: per class, (score, hit) rows of accumulated true/false
+    positives; appending concatenates and keeps the top-capacity rows by
+    score (exact unless a class overflows the capacity, attr
+    ``state_capacity``).  pos_count accumulates gt counts."""
+    import jax
+
+    jnp = _jnp()
+    det_name = op.inputs["DetectRes"][0]
+    det = ctx.get(det_name)                       # [B, K, 6] (label score x0 y0 x1 y1)
+    gtb_name = op.inputs["GtBoxes"][0]
+    gt_boxes = ctx.get(gtb_name)                  # [B, G, 4]
+    gt_labels = ctx.get_input(op, "GtLabels")     # [B, G] or [B, G, 1]
+    gt_lens = ctx.get_lengths(gtb_name)
+    a = op.attrs
+    C = int(a["class_num"])
+    background = int(a.get("background_label", 0))
+    ov_t = float(a.get("overlap_threshold", 0.3))
+    ap_type = a.get("ap_type", "integral")
+    CAP = int(a.get("state_capacity", 512))
+
+    pos_count = ctx.get_input(op, "PosCount")     # [C, 1] int32 (or None)
+    true_pos = ctx.get_input(op, "TruePos")       # [C, CAP, 2]
+    false_pos = ctx.get_input(op, "FalsePos")     # [C, CAP, 2]
+    if gt_labels.ndim == 3:
+        gt_labels = gt_labels[..., 0]
+    B, K = det.shape[:2]
+    G = gt_boxes.shape[1]
+    if gt_lens is None:
+        gt_lens = jnp.full((B,), G, jnp.int32)
+    if pos_count is None:
+        pos_count = jnp.zeros((C, 1), jnp.int32)
+        true_pos = jnp.full((C, CAP, 2), -1.0, jnp.float32)
+        false_pos = jnp.full((C, CAP, 2), -1.0, jnp.float32)
+
+    gmask = jnp.arange(G)[None, :] < gt_lens[:, None]          # [B, G]
+
+    def match_image(db, gb, gl, gm):
+        """Greedy match this image's detections (score desc) to its gt."""
+        scores = jnp.where(db[:, 0] >= 0, db[:, 1], -jnp.inf)
+        order = jnp.argsort(-scores)
+        ds = db[order]
+        iou = _iou(ds[:, 2:6], gb)                             # [K, G]
+
+        def body(i, carry):
+            claimed, tp = carry
+            lab = ds[i, 0].astype(jnp.int32)
+            cand = gm & (gl.astype(jnp.int32) == lab)
+            ious = jnp.where(cand, iou[i], -1.0)
+            j = ious.argmax()
+            hit = (ious[j] >= ov_t) & ~claimed[j] & (ds[i, 0] >= 0)
+            claimed = claimed.at[j].set(claimed[j] | hit)
+            return claimed, tp.at[i].set(hit)
+
+        _, tp = jax.lax.fori_loop(
+            0, K, body, (jnp.zeros(G, bool), jnp.zeros(K, bool)))
+        return ds, tp
+
+    ds_all, tp_all = jax.vmap(match_image)(det, gt_boxes, gt_labels, gmask)
+    ds_flat = ds_all.reshape(B * K, 6)
+    tp_flat = tp_all.reshape(B * K)
+    valid_flat = ds_flat[:, 0] >= 0
+
+    # per-class state update and AP, vmapped over the class axis (a Python
+    # loop would unroll the argsort/cumsum blocks class_num times into the
+    # jitted graph)
+    class_ids = jnp.arange(C, dtype=jnp.int32)
+    det_cls = ds_flat[:, 0].astype(jnp.int32)
+    gt_cls = gt_labels.astype(jnp.int32)
+    sc = ds_flat[:, 1]
+
+    def update_class(c, pc, tpbuf, fpbuf):
+        in_c = valid_flat & (det_cls == c)
+        npos = (gmask & (gt_cls == c)).sum()
+        tp_entry = jnp.stack(
+            [jnp.where(in_c & tp_flat, sc, -1.0), jnp.ones(B * K)], axis=1)
+        fp_entry = jnp.stack(
+            [jnp.where(in_c & ~tp_flat, sc, -1.0), jnp.ones(B * K)], axis=1)
+
+        def fold(buf, new):
+            allrows = jnp.concatenate([buf, new])               # [CAP+BK, 2]
+            sel = jnp.argsort(-allrows[:, 0])[:CAP]
+            return allrows[sel]
+
+        return pc + npos.astype(jnp.int32), fold(tpbuf, tp_entry), fold(fpbuf, fp_entry)
+
+    new_pc, new_tp, new_fp = jax.vmap(update_class)(
+        class_ids, pos_count[:, 0], true_pos, false_pos)
+    pos_count = new_pc[:, None]
+    true_pos = new_tp
+    false_pos = new_fp
+
+    def class_ap(npos, tpbuf, fpbuf):
+        merged_s = jnp.concatenate([tpbuf[:, 0], fpbuf[:, 0]])
+        merged_tp = jnp.concatenate([jnp.ones(CAP), jnp.zeros(CAP)])
+        mvalid = merged_s >= 0
+        order = jnp.argsort(-jnp.where(mvalid, merged_s, -jnp.inf))
+        t = merged_tp[order] * mvalid[order]
+        f = (1 - merged_tp[order]) * mvalid[order]
+        ctp = jnp.cumsum(t)
+        cfp = jnp.cumsum(f)
+        recall = ctp / jnp.maximum(npos, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-12)
+        vrow = mvalid[order]
+        if ap_type == "11point":
+            pts = jnp.linspace(0, 1, 11)
+            prec_at = jax.vmap(
+                lambda t_: jnp.where((recall >= t_) & vrow, precision, 0.0).max()
+            )(pts)
+            ap = prec_at.mean()
+        else:
+            # every-point: running max of precision from the right over steps
+            rprev = jnp.concatenate([jnp.zeros(1), recall[:-1]])
+            pmax = jax.lax.associative_scan(
+                jnp.maximum, precision[::-1])[::-1]
+            ap = jnp.sum(jnp.where(vrow, (recall - rprev) * pmax, 0.0))
+        has = npos > 0
+        return jnp.where(has, ap, 0.0), has
+
+    aps, present = jax.vmap(class_ap)(pos_count[:, 0], true_pos, false_pos)
+    not_bg = class_ids != background
+    aps = jnp.where(not_bg, aps, 0.0)
+    present = present & not_bg
+    m_ap = jnp.sum(aps) / jnp.maximum(present.sum(), 1)
+
+    ctx.set_output(op, "MAP", m_ap.reshape(1))
+    ctx.set_output(op, "AccumPosCount", pos_count)
+    ctx.set_output(op, "AccumTruePos", true_pos)
+    ctx.set_output(op, "AccumFalsePos", false_pos)

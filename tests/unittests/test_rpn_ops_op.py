@@ -1,0 +1,252 @@
+"""RPN / Faster-RCNN detection ops vs numpy references:
+generate_proposals (decode+clip+NMS), rpn_target_assign (fg/bg sampling),
+generate_proposal_labels (RoI sampling + per-class targets),
+roi_perspective_transform (homography warp), polygon_box_transform
+(reference: test_generate_proposals.py, test_rpn_target_assign_op.py,
+test_generate_proposal_labels.py, test_roi_perspective_transform_op.py,
+test_polygon_box_transform.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_output
+
+L = fluid.layers
+
+
+def _np_iou(a, b):
+    ix = np.maximum(
+        np.minimum(a[:, None, 2], b[None, :, 2]) - np.maximum(a[:, None, 0], b[None, :, 0]), 0)
+    iy = np.maximum(
+        np.minimum(a[:, None, 3], b[None, :, 3]) - np.maximum(a[:, None, 1], b[None, :, 1]), 0)
+    inter = ix * iy
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    bb = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = aa[:, None] + bb[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0)
+
+
+def test_polygon_box_transform():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 3, 5).astype("float32")
+
+    def build(v):
+        return L.polygon_box_transform(v["x"])
+
+    jj = np.arange(5)[None, None, None, :]
+    ii = np.arange(3)[None, None, :, None]
+    want = np.where((np.arange(4) % 2 == 0)[None, :, None, None], jj - x, ii - x)
+    check_output(build, {"x": x}, want, rtol=1e-5)
+
+
+def test_generate_proposals_decode_and_nms():
+    rng = np.random.RandomState(1)
+    A, H, W = 2, 3, 3
+    N = A * H * W
+    scores = rng.rand(1, A, H, W).astype("float32")
+    deltas = (rng.randn(1, 4 * A, H, W) * 0.1).astype("float32")
+    im_info = np.array([[32.0, 32.0, 1.0]], "float32")
+    # anchors laid out [H, W, A, 4]
+    anchors = np.zeros((H, W, A, 4), "float32")
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy, s = j * 10 + 5, i * 10 + 5, 6 + 4 * a
+                anchors[i, j, a] = [cx - s, cy - s, cx + s, cy + s]
+    variances = np.ones((H, W, A, 4), "float32")
+
+    def build(v):
+        rois, probs = L.generate_proposals(
+            v["s"], v["d"], v["i"], v["a"], v["v"],
+            pre_nms_top_n=N, post_nms_top_n=6, nms_thresh=0.6, min_size=1.0)
+        return [rois, probs]
+
+    h = OpHarness(build, {"s": scores, "d": deltas, "i": im_info,
+                          "a": anchors, "v": variances})
+    rois, probs = (np.asarray(t) for t in h.outputs())
+
+    # numpy reference
+    anc = anchors.reshape(N, 4)
+    s_flat = scores[0].transpose(1, 2, 0).reshape(N)
+    d_flat = deltas[0].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(N, 4)
+    # legacy +1 pixel convention + log(1000/16) clamp, as the reference BoxCoder
+    pw, ph = anc[:, 2] - anc[:, 0] + 1, anc[:, 3] - anc[:, 1] + 1
+    pcx, pcy = anc[:, 0] + 0.5 * pw, anc[:, 1] + 0.5 * ph
+    cx, cy = d_flat[:, 0] * pw + pcx, d_flat[:, 1] * ph + pcy
+    clip = np.log(1000.0 / 16.0)
+    bw = np.exp(np.minimum(d_flat[:, 2], clip)) * pw
+    bh = np.exp(np.minimum(d_flat[:, 3], clip)) * ph
+    boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2 - 1, cy + bh / 2 - 1], 1)
+    boxes[:, 0::2] = boxes[:, 0::2].clip(0, 31)
+    boxes[:, 1::2] = boxes[:, 1::2].clip(0, 31)
+    order = np.argsort(-s_flat)
+    keep = []
+    for i in order:
+        if all(_np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] <= 0.6 for j in keep):
+            keep.append(i)
+        if len(keep) == 6:
+            break
+    np.testing.assert_allclose(probs[0, :len(keep), 0], s_flat[keep], rtol=1e-5)
+    np.testing.assert_allclose(rois[0, :len(keep)], boxes[keep], rtol=1e-4, atol=1e-4)
+
+
+def test_rpn_target_assign_labels():
+    anchors = np.array([
+        [0, 0, 10, 10], [20, 20, 30, 30], [100, 100, 110, 110], [6, 6, 14, 14],
+    ], "float32")
+    gt = np.array([[[0, 0, 10, 10], [21, 21, 30, 30]]], "float32")
+    B, N = 1, 4
+    pred = np.tile(np.arange(N, dtype="float32")[None, :, None], (B, 1, 4))
+    logits = np.tile(np.arange(N, dtype="float32")[None, :, None], (B, 1, 1))
+
+    var = np.ones_like(anchors)
+
+    def build(v):
+        loc, score, label, tgt = L.rpn_target_assign(
+            v["p"], v["l"], v["a"], v["var"], v["g"],
+            rpn_batch_size_per_im=4, fg_fraction=0.5,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3)
+        return [loc, score, label, tgt]
+
+    h = OpHarness(build, {"p": pred, "l": logits, "a": anchors, "var": var, "g": gt})
+    loc, score, label, tgt = (np.asarray(t) for t in h.outputs())
+    # anchors 0 (IoU 1 with gt0) and 1 (IoU ~0.68 but best for gt1) are fg;
+    # anchor 2 (IoU 0) is bg. Sample: 2 fg + 2 bg slots.
+    assert label[0, 0, 0] == 1 and label[0, 1, 0] == 1
+    assert set(score[0, :2, 0]) == {0.0, 1.0}  # fg = anchors 0 and 1
+    assert (label[0, 2:, 0] == 0).all()
+    # fg rows carry encoded regression targets; anchor 0 == gt -> zeros
+    fg_row = list(score[0, :2, 0]).index(0.0)
+    np.testing.assert_allclose(tgt[0, fg_row], 0, atol=1e-5)
+
+
+def test_generate_proposal_labels_classes_and_targets():
+    rois = np.array([[[0, 0, 10, 10], [18, 18, 31, 31], [50, 50, 60, 60]]], "float32")
+    gt_boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+    gt_classes = np.array([[3, 7]], "int64")
+
+    def build(v):
+        rois_o, labels, tgt, inw, outw = L.generate_proposal_labels(
+            v["r"], v["c"], v["b"], batch_size_per_im=8, fg_fraction=0.5,
+            fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+            bbox_reg_weights=(1.0, 1.0, 1.0, 1.0), class_nums=10)
+        return [rois_o, labels, tgt, inw]
+
+    h = OpHarness(build, {"r": rois, "c": gt_classes, "b": gt_boxes})
+    rois_o, labels, tgt, inw = (np.asarray(t) for t in h.outputs())
+    lab = labels[0, :, 0]
+    # the two gt boxes join the pool, so classes 3 and 7 both appear as fg
+    assert 3 in lab and 7 in lab
+    # fg rows put their 4-wide regression target in the class's column slot
+    for row, c in enumerate(lab):
+        if c > 0:
+            assert inw[0, row, 4 * c:4 * c + 4].sum() == 4
+            assert inw[0, row].sum() == 4
+
+
+def test_roi_perspective_transform_identity_quad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 1, 6, 6).astype("float32")
+    # quad == the whole image rectangle -> output is a straight resample
+    quad = np.array([[0, 0, 5, 0, 5, 5, 0, 5]], "float32")
+
+    def build(v):
+        return L.roi_perspective_transform(v["x"], v["r"], 6, 6, spatial_scale=1.0)
+
+    h = OpHarness(build, {"x": x, "r": quad})
+    (out,) = h.outputs()
+    np.testing.assert_allclose(np.asarray(out)[0, 0], x[0, 0], rtol=1e-4, atol=1e-4)
+
+
+def test_detection_map_in_graph_matches_host_metric():
+    from paddle_tpu import metrics
+
+    K = 4
+    pad = [[-1, 0, 0, 0, 0, 0]]
+    det = np.array([[[1, 0.9, 0, 0, 1, 1], [1, 0.6, 5, 5, 6, 6]] + pad * (K - 2),
+                    [[2, 0.8, 2, 2, 3, 3]] + pad * (K - 1)], "float32")
+    gtb = np.array([[[0, 0, 1, 1], [0, 0, 0, 0]],
+                    [[2, 2, 3, 3], [5, 5, 6, 6]]], "float32")
+    gtl = np.array([[1, 0], [2, 1]], "int64")
+    lens = np.array([1, 2], "int64")
+    from paddle_tpu.lod import LoDArray
+
+    gtb_lod = LoDArray(gtb, lens)
+
+    def build(v):
+        m, pc, tp, fp = L.detection_map(v["d"], v["b"], v["l"], class_num=3,
+                                        overlap_threshold=0.5)
+        return [m, pc]
+
+    h = OpHarness(build, {"d": det, "b": gtb_lod, "l": gtl})
+    m, pc = h.outputs()
+    want = metrics.compute_detection_map(det, gtb, gtl, lens, num_classes=3,
+                                         overlap_threshold=0.5)
+    np.testing.assert_allclose(float(np.ravel(np.asarray(m))[0]), want, rtol=1e-5)
+    np.testing.assert_array_equal(np.ravel(np.asarray(pc)), [0, 2, 1])
+
+
+def test_rpn_target_assign_padded_gt_keeps_forced_fg():
+    """A padded gt row must not clobber anchor 0's forced-foreground flag
+    (regression: duplicate-index scatter)."""
+    anchors = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], "float32")
+    # one valid gt (IoU 0.64 with anchor 0, below pos_overlap) + one pad row
+    from paddle_tpu.lod import LoDArray
+    gt = LoDArray(np.array([[[0, 0, 7, 7], [0, 0, 0, 0]]], "float32"),
+                  np.array([1], "int64"))
+    pred = np.zeros((1, 2, 4), "float32")
+    logits = np.zeros((1, 2, 1), "float32")
+    var = np.ones_like(anchors)
+
+    def build(v):
+        loc, score, label, tgt = L.rpn_target_assign(
+            v["p"], v["l"], v["a"], v["var"], v["g"],
+            rpn_batch_size_per_im=2, fg_fraction=0.5)
+        return [label]
+
+    h = OpHarness(build, {"p": pred, "l": logits, "a": anchors, "var": var, "g": gt})
+    (label,) = h.outputs()
+    assert np.asarray(label)[0, 0, 0] == 1  # anchor 0 is gt0's best anchor
+
+
+def test_generate_proposal_labels_no_gt_yields_background():
+    """Zero ground truth must still produce background samples (regression:
+    negative images contributed nothing)."""
+    rois = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+    from paddle_tpu.lod import LoDArray
+    gt_boxes = LoDArray(np.zeros((1, 1, 4), "float32"), np.array([0], "int64"))
+    gt_classes = LoDArray(np.zeros((1, 1), "int64"), np.array([0], "int64"))
+
+    def build(v):
+        rois_o, labels, tgt, inw, outw = L.generate_proposal_labels(
+            v["r"], v["c"], v["b"], batch_size_per_im=4, class_nums=5)
+        return [rois_o, labels]
+
+    h = OpHarness(build, {"r": rois, "c": gt_classes, "b": gt_boxes})
+    rois_o, labels = (np.asarray(t) for t in h.outputs())
+    # the two valid rois come back as background rows, prefix-packed
+    assert (labels[0, :2, 0] == 0).all()
+    assert np.abs(rois_o[0, :2]).sum() > 0  # real rois, not zero padding
+
+
+def test_generate_proposals_clamps_huge_deltas():
+    """exp deltas are clamped at log(1000/16) — a dw=10 delta must not
+    produce an e^10-scale box (regression: reference BoxCoder clamp)."""
+    A, H, W = 1, 1, 1
+    scores = np.ones((1, A, H, W), "float32")
+    deltas = np.zeros((1, 4, H, W), "float32")
+    deltas[0, 2:] = 10.0  # dw = dh = 10
+    im_info = np.array([[1000.0, 1000.0, 1.0]], "float32")
+    anchors = np.array([[[[10, 10, 19, 19]]]], "float32").reshape(1, 1, 1, 4)
+    variances = np.ones((1, 1, 1, 4), "float32")
+
+    def build(v):
+        rois, probs = L.generate_proposals(
+            v["s"], v["d"], v["i"], v["a"], v["v"],
+            pre_nms_top_n=1, post_nms_top_n=1, min_size=1.0)
+        return [rois]
+
+    h = OpHarness(build, {"s": scores, "d": deltas, "i": im_info,
+                          "a": anchors, "v": variances})
+    (rois,) = h.outputs()
+    w = np.asarray(rois)[0, 0, 2] - np.asarray(rois)[0, 0, 0] + 1
+    assert w <= 10 * (1000.0 / 16.0) + 1  # clamped, not exp(10)*10
