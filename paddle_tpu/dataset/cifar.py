@@ -1,13 +1,20 @@
 """CIFAR-10/100 (reference: python/paddle/dataset/cifar.py).
 
-Synthetic: 3072-float32 vectors in [0, 1] (reference: pixels/255), class
-templates + noise; int64 labels.
+If the real ``cifar-10-python.tar.gz`` / ``cifar-100-python.tar.gz`` sits
+under ``DATA_HOME/cifar/`` (user-supplied), it is parsed like the
+reference: pickled batches out of the tarball, pixels/255 float32, int64
+labels.  Otherwise synthetic: 3072-float32 vectors in [0, 1], class
+templates + noise.
 """
 from __future__ import annotations
 
+import os
+import pickle
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train10", "test10", "train100", "test100"]
 
@@ -15,7 +22,31 @@ TRAIN_SIZE = 1024
 TEST_SIZE = 256
 
 
+def _real_reader(split, num_classes):
+    tar_path = os.path.join(
+        DATA_HOME, "cifar", "cifar-%d-python.tar.gz" % num_classes)
+    if not os.path.exists(tar_path):
+        return None
+    sub = ("data_batch" if split == "train" else "test_batch") \
+        if num_classes == 10 else ("train" if split == "train" else "test")
+
+    def reader():
+        with tarfile.open(tar_path, "r:gz") as tf:
+            members = sorted(m.name for m in tf.getmembers() if sub in m.name)
+            for name in members:
+                batch = pickle.load(tf.extractfile(name), encoding="latin1")
+                labels = batch.get("labels", batch.get("fine_labels"))
+                for img, lab in zip(batch["data"], labels):
+                    yield (img.astype("float32") / 255.0), int(lab)
+
+    return reader
+
+
 def _reader_creator(split, num_classes, size):
+    real = _real_reader(split, num_classes)
+    if real is not None:
+        return real
+
     def reader():
         r_t = rng_for("cifar%d" % num_classes, "templates")
         tpl = r_t.rand(num_classes, 3072).astype("float32")

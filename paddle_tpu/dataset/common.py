@@ -122,3 +122,22 @@ def convert(output_path, reader, line_count, name_prefix):
     if w is not None:
         w.close()
     return paths
+
+
+def master_files_reader(endpoint, loader=None):
+    """Fault-tolerant counterpart of ``cluster_files_reader``: instead of a
+    static ``i % trainer_count`` shard, each trainer leases file chunks from
+    a ``paddle_tpu.reader.master.Master``; files of a dead trainer are
+    redispatched to the survivors (reference: go/master/service.go)."""
+    import pickle as _pickle
+
+    from ..reader.master import master_task_reader
+
+    loader = loader or _pickle.load
+
+    def chunk_reader(path):
+        with open(path, "rb") as f:
+            for sample in loader(f):
+                yield sample
+
+    return master_task_reader(endpoint, chunk_reader)

@@ -1,0 +1,149 @@
+"""Image pipeline utilities (reference: python/paddle/dataset/image.py).
+
+The reference shells out to cv2 for everything; these are pure-numpy
+implementations of the same surface (bilinear resize, crops, flip, the
+simple_transform composition) so the pipelines run in this image-less
+environment.  File decoding (`load_image`) is gated on PIL/cv2 being
+importable — array-in/array-out transforms never need either.
+
+Arrays are HWC uint8/float unless noted; ``to_chw`` moves to the CHW
+layout the conv stack consumes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "load_image",
+    "load_image_bytes",
+    "resize_short",
+    "to_chw",
+    "center_crop",
+    "random_crop",
+    "left_right_flip",
+    "simple_transform",
+    "load_and_transform",
+    "batch_images",
+]
+
+
+def _bilinear_resize(img, h_out, w_out):
+    """HWC bilinear resample, pixel-center convention, float64 math."""
+    h, w = img.shape[:2]
+    x = (np.arange(w_out) + 0.5) * (w / w_out) - 0.5
+    y = (np.arange(h_out) + 0.5) * (h / h_out) - 0.5
+    x = np.clip(x, 0, w - 1)
+    y = np.clip(y, 0, h - 1)
+    x0 = np.floor(x).astype(int)
+    y0 = np.floor(y).astype(int)
+    x1 = np.minimum(x0 + 1, w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    wx = (x - x0)[None, :, None]
+    wy = (y - y0)[:, None, None]
+    img = img.astype(np.float64)
+    if img.ndim == 2:
+        img = img[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    out = (img[np.ix_(y0, x0)] * (1 - wy) * (1 - wx)
+           + img[np.ix_(y1, x0)] * wy * (1 - wx)
+           + img[np.ix_(y0, x1)] * (1 - wy) * wx
+           + img[np.ix_(y1, x1)] * wy * wx)
+    return out[..., 0] if squeeze else out
+
+
+def load_image_bytes(data, is_color=True):
+    """Decode an encoded image byte string (PIL or cv2 required)."""
+    try:
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data))
+        img = img.convert("RGB" if is_color else "L")
+        return np.asarray(img)
+    except ImportError:
+        pass
+    try:
+        import cv2
+
+        flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+        arr = cv2.imdecode(np.frombuffer(data, np.uint8), flag)
+        return arr[:, :, ::-1] if is_color else arr  # BGR -> RGB
+    except ImportError:
+        raise ImportError(
+            "decoding image bytes needs PIL or cv2; neither is installed "
+            "(array-based transforms in this module work without them)")
+
+
+def load_image(file_path, is_color=True):
+    with open(file_path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im, size):
+    """Scale so the shorter edge equals ``size``, preserving aspect."""
+    h, w = im.shape[:2]
+    if h <= w:
+        h_new, w_new = size, int(round(w * size / h))
+    else:
+        h_new, w_new = int(round(h * size / w)), size
+    out = _bilinear_resize(im, h_new, w_new)
+    return out.astype(im.dtype) if np.issubdtype(im.dtype, np.integer) else out
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, h - size + 1)
+    w0 = rng.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """The reference's standard pipeline: resize_short -> (random crop +
+    maybe flip | center crop) -> CHW float32 -> mean subtraction."""
+    im = resize_short(im, resize_size)
+    rng = rng or np.random
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    im = to_chw(im).astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, "float32")
+        im -= mean.reshape(-1, 1, 1) if mean.ndim == 1 else mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(
+        load_image(filename, is_color), resize_size, crop_size, is_train,
+        is_color, mean)
+
+
+def batch_images(images):
+    """Stack CHW images into one NCHW batch array."""
+    return np.stack([np.asarray(im) for im in images]).astype("float32")

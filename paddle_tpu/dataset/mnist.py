@@ -1,14 +1,20 @@
 """MNIST (reference: python/paddle/dataset/mnist.py — 60k/10k ubyte files).
 
-Synthetic: each sample is a 784-float32 vector in [-1, 1] (the reference
-normalizes pixels to that range) drawn from a per-class template + noise,
-so classifiers genuinely learn; labels are int64 in [0, 10).
+If the real IDX files are present under ``DATA_HOME/mnist/`` (user-supplied
+— this environment cannot download), they are parsed exactly like the
+reference: gzip'd idx3/idx1, pixels normalized to [-1, 1], labels int64.
+Otherwise: deterministic synthetic samples with the same schema, drawn from
+a per-class template + noise so classifiers genuinely learn.
 """
 from __future__ import annotations
 
+import gzip
+import os
+import struct
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test"]
 
@@ -21,8 +27,37 @@ def _templates():
     return r.randn(10, 784).astype("float32")
 
 
+def _real_paths(split):
+    stem = "train" if split == "train" else "t10k"
+    base = os.path.join(DATA_HOME, "mnist")
+    imgs = os.path.join(base, "%s-images-idx3-ubyte.gz" % stem)
+    labs = os.path.join(base, "%s-labels-idx1-ubyte.gz" % stem)
+    if os.path.exists(imgs) and os.path.exists(labs):
+        return imgs, labs
+    return None
+
+
+def _parse_idx(imgs_path, labs_path):
+    """The reference's ubyte parsing: [magic,n,rows,cols] big-endian headers,
+    pixels scaled to [-1, 1] float32."""
+    with gzip.open(labs_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(n), np.uint8).astype("int64")
+    with gzip.open(imgs_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        pixels = np.frombuffer(f.read(n * rows * cols), np.uint8)
+        images = pixels.reshape(n, rows * cols).astype("float32") / 255.0 * 2.0 - 1.0
+    return images, labels
+
+
 def _reader_creator(split, size):
     def reader():
+        real = _real_paths(split)
+        if real is not None:
+            images, labels = _parse_idx(*real)
+            for img, lab in zip(images, labels):
+                yield img, int(lab)
+            return
         tpl = _templates()
         r = rng_for("mnist", split)
         for _ in range(size):

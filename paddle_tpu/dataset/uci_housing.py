@@ -1,14 +1,19 @@
 """UCI housing regression (reference: python/paddle/dataset/uci_housing.py —
 506 samples, 13 features, normalized).
 
-Synthetic: x ~ N(0,1)^13, y = x·w + noise with a fixed hidden w, so linear
-regression converges exactly like on the real data.
+If ``DATA_HOME/uci_housing/housing.data`` exists (user-supplied), it is
+parsed like the reference: whitespace table, features max/min/avg
+normalized over the full set, 80/20 train/test split.  Otherwise synthetic:
+x ~ N(0,1)^13, y = x·w + noise with a fixed hidden w, so linear regression
+converges exactly like on the real data.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test", "feature_names"]
 
@@ -25,8 +30,28 @@ def _w():
     return rng_for("uci_housing", "w").randn(13).astype("float32")
 
 
+def _real_data():
+    path = os.path.join(DATA_HOME, "uci_housing", "housing.data")
+    if not os.path.exists(path):
+        return None
+    raw = np.loadtxt(path).astype("float32")  # [506, 14]
+    feats = raw[:, :13]
+    # reference feature_range normalization: (x - avg) / (max - min)
+    mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+    feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+    data = np.concatenate([feats, raw[:, 13:]], axis=1)
+    split_at = int(len(data) * 0.8)
+    return data[:split_at], data[split_at:]
+
+
 def _reader_creator(split, size):
     def reader():
+        real = _real_data()
+        if real is not None:
+            rows = real[0] if split == "train" else real[1]
+            for row in rows:
+                yield row[:13].astype("float32"), row[13:14].astype("float32")
+            return
         w = _w()
         r = rng_for("uci_housing", split)
         for _ in range(size):

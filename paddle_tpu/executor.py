@@ -317,12 +317,47 @@ def _propagate_lengths(ctx: LoweringContext, op):
                 ctx.env[n + "@LENGTHS"] = lens
 
 
+_NAN_DEBUG = {"on": False}
+
+
+def set_nan_debug(enable=True):
+    """Executor NaN/Inf debug mode (reference: the per-op CheckNanInf pass
+    enabled by FLAGS_check_nan_inf).  When on, every float op output gets a
+    ``jax.debug.callback`` probe that reports the producing op and variable
+    the moment a non-finite value appears — inside jit, on device."""
+    _NAN_DEBUG["on"] = bool(enable)
+
+
+def _nan_probe(op_type, var_name, value):
+    import numpy as np_
+
+    arr = np_.asarray(value)
+    if not np_.isfinite(arr).all():
+        bad = "nan" if np_.isnan(arr).any() else "inf"
+        raise FloatingPointError(
+            "non-finite (%s) value in output %r of op %r" % (bad, var_name, op_type)
+        )
+
+
 def interpret_ops(ctx: LoweringContext, ops):
     """Straight-line trace of an op list (no backward meta-op)."""
+    import functools
+
     for op in ops:
         rule = get_rule(op.type)
         rule(ctx, op)
         _propagate_lengths(ctx, op)
+        if _NAN_DEBUG["on"]:
+            import jax
+            import jax.numpy as jnp
+
+            for outs in op.outputs.values():
+                for name in outs:
+                    v = ctx.env.get(name)
+                    if v is not None and hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+                        jax.debug.callback(
+                            functools.partial(_nan_probe, op.type, name), v
+                        )
 
 
 def lower_block(ctx: LoweringContext, block: Block):
@@ -439,6 +474,8 @@ def lower_block(ctx: LoweringContext, block: Block):
 class Executor:
     """exe = Executor(TPUPlace()); exe.run(program, feed=..., fetch_list=...)"""
 
+    _CACHE_CAP = 64  # compiled (program, shapes) entries kept per executor
+
     def __init__(self, place=None):
         from .core import TPUPlace
 
@@ -483,16 +520,22 @@ class Executor:
         key = self._rng_key(program, scope)
 
         sig = (
-            id(program),
-            program.version,
+            program.fingerprint(),
             tuple(sorted((n, tuple(np.shape(v)), str(np.asarray(v).dtype) if not hasattr(v, "dtype") else str(v.dtype)) for n, v in feed_arrays.items())),
             tuple(fetch_names),
             tuple(sorted(state_in)),
+            _NAN_DEBUG["on"],  # probes are baked into the executable
         )
         entry = self._cache.get(sig) if use_program_cache else None
+        if entry is not None:
+            # LRU touch: re-inserting keeps hot entries at the young end
+            del self._cache[sig]
+            self._cache[sig] = entry
         if entry is None:
             entry = self._build(program, sorted(feed_arrays), fetch_names, sorted(state_in))
             if use_program_cache:
+                while len(self._cache) >= self._CACHE_CAP:
+                    self._cache.pop(next(iter(self._cache)))  # oldest entry
                 self._cache[sig] = entry
 
         from . import profiler as _prof
@@ -605,12 +648,17 @@ class Executor:
         repl = NamedSharding(mesh, P())
         cell = {}
 
+        # only declared data vars batch-shard on dp: a coincidentally
+        # batch-divisible non-data feed (e.g. a [ndev*k, d] constant table)
+        # must stay replicated
+        data_names = {v.name for v in program.list_vars() if getattr(v, "is_data", False)}
+
         def runner(state, feeds, key):
             jitted = cell.get("jit")
             if jitted is None:
                 feed_shardings = {
                     n: NamedSharding(mesh, P("dp"))
-                    if np.ndim(v) >= 1 and np.shape(v)[0] % ndev == 0
+                    if n in data_names and np.ndim(v) >= 1 and np.shape(v)[0] % ndev == 0
                     else repl
                     for n, v in feeds.items()
                 }

@@ -395,6 +395,21 @@ class Program:
     def version(self):
         return self._version
 
+    def fingerprint(self):
+        """Stable content hash of the program structure.  Used as the
+        executor cache key — ``id(program)`` is recycled by the GC, so two
+        different programs could otherwise collide in the compile cache.
+        Recomputed only when the version bumps."""
+        import hashlib
+
+        cached = getattr(self, "_fingerprint_cache", None)
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        fp = hashlib.sha256(
+            json.dumps(self.to_dict(), sort_keys=True).encode()).hexdigest()
+        self._fingerprint_cache = (self._version, fp)
+        return fp
+
     def block(self, idx: int) -> Block:
         return self.blocks[idx]
 

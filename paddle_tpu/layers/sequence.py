@@ -338,6 +338,10 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
     """Pad/re-pad a sequence batch to ``maxlen`` (reference nn.py:2796).
     Returns (padded, lengths)."""
     helper = LayerHelper("sequence_pad", **locals())
+    if not hasattr(pad_value, "name"):  # python scalar -> constant var
+        from .tensor import fill_constant
+
+        pad_value = fill_constant(shape=[1], dtype=str(x.dtype), value=float(pad_value))
     out = helper.create_variable_for_type_inference(x.dtype)
     length = helper.create_variable_for_type_inference("int64", stop_gradient=True)
     helper.append_op(

@@ -448,3 +448,23 @@ def _piecewise_decay(ctx, op):
     values = jnp.asarray(op.attrs["values"], dtype="float32")
     idx = jnp.sum((step >= boundaries).astype("int32"))
     ctx.set_output(op, "Out", values[idx].reshape(1))
+
+
+@register("load")
+def _load(ctx, op):
+    """Bind a variable from an io.save_vars .npy file (reference
+    operators/load_op.cc).  The file is read host-side at trace time and
+    enters the executable as a constant."""
+    import numpy as np
+
+    path = op.attrs["file_path"]
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    arr = np.load(path)
+    name = op.outputs["Out"][0]
+    var = ctx.var(name)
+    if var is not None and var.dtype:
+        arr = arr.astype(to_jdtype(str(var.dtype)))
+    if op.attrs.get("load_as_fp16"):
+        arr = arr.astype("float16")
+    ctx.set_output(op, "Out", arr)

@@ -27,3 +27,4 @@ def batch(reader, batch_size, drop_last=False):
             yield b
 
     return batch_reader
+from .master import Master, MasterClient, master_task_reader  # noqa: F401

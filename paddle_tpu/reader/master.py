@@ -1,0 +1,244 @@
+"""Fault-tolerant data-dispatch master (reference analog: go/master —
+service.go's chunk task queue with lease/timeout requeue).
+
+One ``Master`` owns the epoch's chunk list (file paths, or any picklable
+work units).  Trainers pull tasks over TCP; every lease carries a
+deadline, and a chunk whose trainer dies (or just stalls past the lease)
+is requeued and handed to the next caller — so a crashed trainer's data
+is still trained on, at-least-once.  A chunk that fails ``max_failures``
+times is dropped with a warning (reference: MaxChunksFailure).
+
+Transport is the same length-prefixed pickle as the dense pserver
+(transpiler/pserver_runtime.py); the master is host-side control plane,
+never on the TPU path.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+
+__all__ = ["Master", "MasterClient", "master_task_reader"]
+
+log = logging.getLogger(__name__)
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class Master:
+    """Chunk-queue server for one pass over the data."""
+
+    def __init__(self, chunks, lease_seconds=10.0, max_failures=3):
+        self._todo = [(i, c) for i, c in enumerate(chunks)]
+        self._pending = {}  # task_id -> (chunk, deadline)
+        self._failures = {}  # task_id -> count
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._lease = float(lease_seconds)
+        self._max_failures = int(max_failures)
+        self._sock = None
+        self._thread = None
+        self._stop = threading.Event()
+        self.port = None
+
+    # -- queue core (usable in-process without the TCP layer) ---------------
+
+    def _requeue_expired(self, now):
+        expired = [tid for tid, (_, dl) in self._pending.items() if dl <= now]
+        for tid in expired:
+            chunk, _ = self._pending.pop(tid)
+            self._fail_locked(tid, chunk, "lease expired")
+
+    def _fail_locked(self, tid, chunk, why):
+        n = self._failures.get(tid, 0) + 1
+        self._failures[tid] = n
+        if n >= self._max_failures:
+            self._dropped += 1
+            log.warning("master: dropping chunk %r after %d failures (%s)", tid, n, why)
+        else:
+            self._todo.append((tid, chunk))
+
+    def get_task(self):
+        """-> ("task", id, chunk) | ("wait",) while leases are in flight |
+        ("done",) when the pass is complete."""
+        with self._lock:
+            now = time.monotonic()
+            self._requeue_expired(now)
+            if self._todo:
+                tid, chunk = self._todo.pop(0)
+                self._pending[tid] = (chunk, now + self._lease)
+                return ("task", tid, chunk)
+            if self._pending:
+                return ("wait",)
+            return ("done",)
+
+    def task_finished(self, tid):
+        with self._lock:
+            self._pending.pop(tid, None)
+
+    def task_failed(self, tid):
+        with self._lock:
+            if tid in self._pending:
+                chunk, _ = self._pending.pop(tid)
+                self._fail_locked(tid, chunk, "reported failed")
+
+    def done(self):
+        with self._lock:
+            self._requeue_expired(time.monotonic())
+            return not self._todo and not self._pending
+
+    # -- TCP layer ----------------------------------------------------------
+
+    def start(self, port=0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def _serve(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._sock.settimeout(0.2)
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+        finally:
+            try:
+                self._sock.close()  # a client 'stop' must release the port too
+            except OSError:
+                pass
+
+    def _handle(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg[0]
+                if op == "get":
+                    _send_msg(conn, self.get_task())
+                elif op == "finish":
+                    self.task_finished(msg[1])
+                    _send_msg(conn, ("ok",))
+                elif op == "fail":
+                    self.task_failed(msg[1])
+                    _send_msg(conn, ("ok",))
+                elif op == "stop":
+                    _send_msg(conn, ("ok",))
+                    self._stop.set()
+                    return
+                else:
+                    _send_msg(conn, ("err", "unknown op %r" % (op,)))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class MasterClient:
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+
+    def _call(self, *msg):
+        _send_msg(self._sock, msg)
+        return _recv_msg(self._sock)
+
+    def get_task(self, poll_interval=0.1):
+        """Block until a task is available; None when the pass is done."""
+        while True:
+            resp = self._call("get")
+            if resp is None:
+                raise ConnectionError("master connection lost")
+            if resp[0] == "task":
+                return resp[1], resp[2]
+            if resp[0] == "done":
+                return None
+            time.sleep(poll_interval)
+
+    def task_finished(self, tid):
+        self._call("finish", tid)
+
+    def task_failed(self, tid):
+        self._call("fail", tid)
+
+    def stop_master(self):
+        self._call("stop")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def master_task_reader(endpoint, chunk_reader):
+    """Reader factory: pull chunk tasks from the master at ``endpoint`` and
+    stream ``chunk_reader(chunk)``'s records.  A chunk is acknowledged only
+    after it is fully consumed, so a trainer that dies mid-chunk leaves the
+    lease to expire and the chunk is redispatched to a surviving trainer
+    (the fault-tolerant analog of ``cluster_files_reader``'s static
+    sharding)."""
+
+    def reader():
+        client = MasterClient(endpoint)
+        try:
+            while True:
+                task = client.get_task()
+                if task is None:
+                    return
+                tid, chunk = task
+                try:
+                    for sample in chunk_reader(chunk):
+                        yield sample
+                except GeneratorExit:
+                    raise
+                except Exception:
+                    client.task_failed(tid)
+                    raise
+                client.task_finished(tid)
+        finally:
+            client.close()
+
+    return reader

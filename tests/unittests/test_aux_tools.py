@@ -125,3 +125,30 @@ def test_op_freq_statistic():
     assert single["mul"] >= 2 and "softmax" in single
     assert any("mul->" in k for k in pair)
     assert list(single.values()) == sorted(single.values(), reverse=True)
+
+
+def test_per_op_profile_report():
+    """profile_program emits a reference-style sorted per-op table with one
+    row per op type of a conv+fc program."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.jax_bridge import init_state
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        y = fluid.layers.conv2d(x, num_filters=4, filter_size=3, act="relu")
+        y = fluid.layers.pool2d(y, pool_size=2, pool_stride=2)
+        out = fluid.layers.fc(y, size=5)
+    state = init_state(startup)
+    rng = np.random.RandomState(0)
+    report = fluid.profiler.profile_program(
+        main, {"x": rng.randn(2, 3, 8, 8).astype("float32")}, state=state, iters=3)
+    lines = report.splitlines()
+    assert lines[0].split()[:2] == ["Op", "Calls"]
+    body = [ln.split()[0] for ln in lines[1:]]
+    for op_type in ("conv2d", "pool2d", "relu", "mul"):
+        assert op_type in body, (op_type, body)
+    # sorted by total time, descending
+    totals = [float(ln.split()[2]) for ln in lines[1:]]
+    assert totals == sorted(totals, reverse=True)

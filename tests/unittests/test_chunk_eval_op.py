@@ -1,0 +1,53 @@
+"""chunk_eval: IOB chunk extraction + counts vs a python reference
+(reference: test_chunk_eval_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness
+
+L = fluid.layers
+
+
+def _chunks_iob(tags, n_types):
+    """(begin, inside) scheme: tag = type*2 (B) or type*2+1 (I).  Like the
+    reference, an I that does not continue a same-type chunk *starts* one
+    (conll semantics)."""
+    out = []
+    start, ctype = None, None
+    for i, t in enumerate(list(tags) + [-1]):
+        typ = t // 2 if t >= 0 else None
+        is_b = t >= 0 and t % 2 == 0
+        is_i = t >= 0 and t % 2 == 1
+        cont = is_i and start is not None and typ == ctype
+        if start is not None and not cont:
+            out.append((start, i, ctype))
+            start, ctype = None, None
+        if is_b or (is_i and start is None):
+            start, ctype = i, typ
+    return set(out)
+
+
+def test_chunk_eval_counts():
+    lab_seqs = [np.array([0, 1, 4, 2, 3], "int64"), np.array([2, 3, 3], "int64")]
+    inf_seqs = [np.array([0, 1, 4, 0, 3], "int64"), np.array([2, 3, 1], "int64")]
+    label = pack_sequences(lab_seqs)
+    infer = pack_sequences(inf_seqs)
+
+    def build(v):
+        pr, rc, f1, n_inf, n_lab, n_cor = L.chunk_eval(
+            v["inf"], v["lab"], chunk_scheme="IOB", num_chunk_types=3)
+        return [n_inf, n_lab, n_cor, pr, rc, f1]
+
+    h = OpHarness(build, {"inf": infer, "lab": label})
+    n_inf, n_lab, n_cor, pr, rc, f1 = (float(np.ravel(np.asarray(t))[0]) for t in h.outputs())
+
+    want_inf = want_lab = want_cor = 0
+    for ls, is_ in zip(lab_seqs, inf_seqs):
+        lc, ic = _chunks_iob(ls, 3), _chunks_iob(is_, 3)
+        want_lab += len(lc)
+        want_inf += len(ic)
+        want_cor += len(lc & ic)
+    assert (n_inf, n_lab, n_cor) == (want_inf, want_lab, want_cor)
+    np.testing.assert_allclose(pr, want_cor / want_inf, rtol=1e-5)
+    np.testing.assert_allclose(rc, want_cor / want_lab, rtol=1e-5)

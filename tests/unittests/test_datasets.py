@@ -97,3 +97,47 @@ def test_batch_and_convert(tmp_path):
 
     n = sum(1 for _ in recordio_io.Reader(paths[0]).iter_samples())
     assert n == 100
+
+
+def test_mnist_real_idx_parser(tmp_path, monkeypatch):
+    """When real ubyte.gz files exist under DATA_HOME, they are parsed
+    instead of the synthetic fallback."""
+    import gzip
+    import struct
+
+    from paddle_tpu.dataset import common, mnist
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    monkeypatch.setattr(mnist, "DATA_HOME", str(tmp_path))
+    d = tmp_path / "mnist"
+    d.mkdir()
+    n, rows, cols = 3, 28, 28
+    pixels = (np.arange(n * rows * cols) % 256).astype(np.uint8)
+    with gzip.open(d / "t10k-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols) + pixels.tobytes())
+    with gzip.open(d / "t10k-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + bytes([7, 1, 4]))
+
+    samples = list(mnist.test()())
+    assert len(samples) == 3
+    img, lab = samples[0]
+    assert lab == 7 and img.shape == (784,)
+    np.testing.assert_allclose(img, pixels[:784].astype("float32") / 255 * 2 - 1, rtol=1e-6)
+
+
+def test_image_transforms():
+    from paddle_tpu.dataset import image as img_mod
+
+    rng = np.random.RandomState(0)
+    im = (rng.rand(40, 60, 3) * 255).astype(np.uint8)
+    r = img_mod.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20 and r.shape[1] == 30
+    c = img_mod.center_crop(r, 16)
+    assert c.shape[:2] == (16, 16)
+    f = img_mod.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, ::-1], c)
+    out = img_mod.simple_transform(im, 24, 16, is_train=False,
+                                   mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32
+    batch = img_mod.batch_images([out, out])
+    assert batch.shape == (2, 3, 16, 16)

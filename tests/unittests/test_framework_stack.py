@@ -262,3 +262,53 @@ def test_reader_decorators_compose():
 
     buffered = reader.decorator.buffered(lambda: iter(range(5)), size=2)
     assert list(buffered()) == list(range(5))
+
+
+def test_executor_cache_key_is_program_fingerprint():
+    """Two structurally identical programs share one cache entry; gc'ing a
+    program can't poison the cache for a new one at the same id()."""
+    import numpy as np
+
+    def build():
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+                out = fluid.layers.scale(x, scale=2.0)
+        return main, startup, out
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), "float32")
+    m1, s1, o1 = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(s1)
+        exe.run(m1, feed={"x": xv}, fetch_list=[o1])
+    n_after_first = len(exe._cache)
+    m2, s2, o2 = build()
+    assert m1.fingerprint() == m2.fingerprint()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(s2)
+        exe.run(m2, feed={"x": xv}, fetch_list=[o2])
+    assert len(exe._cache) == n_after_first  # same structure -> same entry
+
+
+def test_executor_nan_debug_names_offending_op():
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu import executor as exec_mod
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)        # log(-1) -> nan
+        z = fluid.layers.scale(y, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exec_mod.set_nan_debug(True)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with _pytest.raises(Exception, match="log"):
+                exe.run(main, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                        fetch_list=[z])
+    finally:
+        exec_mod.set_nan_debug(False)

@@ -104,3 +104,43 @@ def test_aot_compiled_inference():
 
     with pytest.raises(Exception):
         compiled(state, {"x": xv[:3]})  # different batch: no silent retrace
+
+
+def test_load_layer_reads_saved_var(tmp_path):
+    import numpy as np
+
+    w = np.arange(6, dtype="float32").reshape(2, 3)
+    np.save(str(tmp_path / "w.npy"), w)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = fluid.layers.create_tensor(dtype="float32", name="loaded_w")
+        fluid.layers.load(out, str(tmp_path / "w.npy"))
+        doubled = fluid.layers.scale(out, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={}, fetch_list=[doubled])
+    np.testing.assert_allclose(got, 2 * w, rtol=1e-6)
+
+
+def test_random_data_generator_and_preprocessor():
+    import numpy as np
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gen = fluid.layers.random_data_generator(
+            low=0.0, high=1.0, shapes=[[4, 3], [4, 1]], lod_levels=[0, 0])
+        pre = fluid.layers.Preprocessor(reader=gen)
+        with pre.block():
+            img, lbl = pre.inputs()
+            pre.outputs(fluid.layers.scale(img, scale=2.0),
+                        fluid.layers.scale(lbl, scale=0.0, bias=7.0))
+        img2, lbl2 = fluid.layers.read_file(pre())
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        a, b = exe.run(main, feed={}, fetch_list=[img2, lbl2])
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.shape == (4, 3) and (a >= 0).all() and (a <= 2).all()
+    np.testing.assert_allclose(b, np.full((4, 1), 7.0), rtol=1e-6)

@@ -1,0 +1,50 @@
+"""logical_{and,or,xor,not} and compare ops (less_than, less_equal,
+greater_than, greater_equal, equal, not_equal) — forward vs numpy
+(reference: test_logical_op.py, test_compare_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_output
+
+L = fluid.layers
+
+_LOGICAL = {
+    "and": (lambda v: L.logical_and(v["a"], v["b"]), np.logical_and),
+    "or": (lambda v: L.logical_or(v["a"], v["b"]), np.logical_or),
+    "xor": (lambda v: L.logical_xor(v["a"], v["b"]), np.logical_xor),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_LOGICAL))
+def test_logical_binary(name):
+    build, ref = _LOGICAL[name]
+    rng = np.random.RandomState(0)
+    a = (rng.rand(3, 4) > 0.5)
+    b = (rng.rand(3, 4) > 0.5)
+    check_output(build, {"a": a, "b": b}, ref(a, b), rtol=0)
+
+
+def test_logical_not():
+    rng = np.random.RandomState(1)
+    a = rng.rand(3, 4) > 0.5
+    check_output(lambda v: L.logical_not(v["a"]), {"a": a}, ~a, rtol=0)
+
+
+_COMPARE = {
+    "less_than": (lambda v: L.less_than(v["a"], v["b"]), np.less),
+    "less_equal": (lambda v: L.less_equal(v["a"], v["b"]), np.less_equal),
+    "greater_than": (lambda v: L.greater_than(v["a"], v["b"]), np.greater),
+    "greater_equal": (lambda v: L.greater_equal(v["a"], v["b"]), np.greater_equal),
+    "equal": (lambda v: L.equal(v["a"], v["b"]), np.equal),
+    "not_equal": (lambda v: L.not_equal(v["a"], v["b"]), np.not_equal),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_COMPARE))
+def test_compare(name):
+    build, ref = _COMPARE[name]
+    rng = np.random.RandomState(2)
+    a = rng.randint(0, 4, size=(3, 5)).astype("int64")
+    b = rng.randint(0, 4, size=(3, 5)).astype("int64")
+    check_output(build, {"a": a, "b": b}, ref(a, b), rtol=0)

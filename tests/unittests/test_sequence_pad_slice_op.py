@@ -1,0 +1,74 @@
+"""sequence_softmax, sequence_pad/unpad, sequence_slice — forward refs on
+the padded+lengths layout + grads (reference: test_sequence_softmax_op.py,
+test_sequence_pad_op.py, test_sequence_slice_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from op_test import OpHarness, check_grad
+
+L = fluid.layers
+
+
+def test_sequence_softmax_masks_padding():
+    rng = np.random.RandomState(0)
+    lens = [3, 5]
+    x = pack_sequences([rng.randn(n).astype("float32") for n in lens])
+
+    def build(v):
+        return L.sequence_softmax(v["x"])
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    got = np.asarray(got)
+    for b, n in enumerate(lens):
+        e = np.exp(x.data[b, :n] - x.data[b, :n].max())
+        np.testing.assert_allclose(
+            np.ravel(got[b])[:n], e / e.sum(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.ravel(got[b])[:n].sum(), 1.0, rtol=1e-5)
+    check_grad(build, {"x": x}, ["x"])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    rng = np.random.RandomState(1)
+    lens = [2, 4]
+    x = pack_sequences([rng.randn(n, 3).astype("float32") for n in lens])
+
+    def build(v):
+        padded, plen = L.sequence_pad(v["x"], pad_value=0.0, maxlen=5)
+        return [padded, plen]
+
+    h = OpHarness(build, {"x": x})
+    padded, plen = (np.asarray(t) for t in h.outputs())
+    assert padded.shape[1] == 5
+    np.testing.assert_array_equal(np.ravel(plen), lens)
+    for b, n in enumerate(lens):
+        np.testing.assert_allclose(padded[b, :n], x.data[b, :n], rtol=1e-6)
+        np.testing.assert_allclose(padded[b, n:], 0.0, atol=1e-7)
+
+    def build_unpad(v):
+        padded, plen = L.sequence_pad(v["x"], pad_value=9.0, maxlen=5)
+        return L.sequence_unpad(padded, plen)
+
+    h2 = OpHarness(build_unpad, {"x": x})
+    (back,) = h2.outputs()
+    back = np.asarray(back)
+    for b, n in enumerate(lens):
+        np.testing.assert_allclose(back[b, :n], x.data[b, :n], rtol=1e-6)
+
+
+def test_sequence_slice():
+    rng = np.random.RandomState(2)
+    x = pack_sequences([rng.randn(5, 2).astype("float32"),
+                        rng.randn(4, 2).astype("float32")])
+    offset = np.array([[1], [0]], "int64")
+    length = np.array([[3], [2]], "int64")
+
+    def build(v):
+        return L.sequence_slice(v["x"], v["o"], v["l"])
+
+    h = OpHarness(build, {"x": x, "o": offset, "l": length})
+    (got,) = h.outputs()
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, :3], x.data[0, 1:4], rtol=1e-6)
+    np.testing.assert_allclose(got[1, :2], x.data[1, 0:2], rtol=1e-6)
