@@ -1,0 +1,49 @@
+"""beam_search single-step op (static-beam contract): flat top-k over the
+accumulated candidate scores with explicit parenthood, vs numpy
+(reference: test_beam_search_op.py; the e2e decode path lives in
+test_transformer_decode.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+L = fluid.layers
+
+
+def test_beam_search_step_topk():
+    # batch 1, beam 2, K=4 candidates/beam; scores are ACCUMULATED log-probs
+    pre_ids = np.array([[1, 2]], "int64")
+    pre_scores = np.array([[-0.5, -1.0]], "float32")
+    cand_ids = np.tile(np.arange(4, dtype="int64")[None, None, :], (1, 2, 1))
+    probs = np.array([[[0.4, 0.3, 0.2, 0.1],
+                       [0.1, 0.2, 0.3, 0.4]]], "float32")
+    acc = pre_scores[..., None] + np.log(probs)  # [1, 2, 4]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids_v = L.data(name="pre_ids", shape=[2], dtype="int64")
+        sc_v = L.data(name="pre_scores", shape=[2], dtype="float32")
+        cand_v = L.data(name="cand", shape=[2, 4], dtype="int64")
+        acc_v = L.data(name="acc", shape=[2, 4], dtype="float32")
+        sel_ids, sel_scores, parent = L.beam_search(
+            pre_ids=ids_v, pre_scores=sc_v, ids=cand_v, scores=acc_v,
+            beam_size=2, end_id=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got_ids, got_scores, got_parent = exe.run(
+            main,
+            feed={"pre_ids": pre_ids, "pre_scores": pre_scores,
+                  "cand": cand_ids, "acc": acc},
+            fetch_list=[sel_ids, sel_scores, parent])
+    got_ids = np.ravel(np.asarray(got_ids))
+    got_scores = np.ravel(np.asarray(got_scores))
+    got_parent = np.ravel(np.asarray(got_parent))
+
+    flat = acc[0].reshape(-1)
+    top = np.argsort(-flat)[:2]
+    # elementwise: the (id, score, parent) triples must be the descending
+    # top-k, correctly paired (order within the beam axis is score-desc)
+    order = np.argsort(-got_scores)
+    np.testing.assert_allclose(got_scores[order], flat[top], rtol=1e-4)
+    np.testing.assert_array_equal(got_ids[order], top % 4)
+    np.testing.assert_array_equal(got_parent[order], top // 4)

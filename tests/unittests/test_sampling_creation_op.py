@@ -1,0 +1,64 @@
+"""sampling_id, *_batch_size_like creation ops, shape, increment,
+is_empty — forward/statistical checks (reference: test_sampling_id_op.py,
+test_uniform_random_batch_size_like_op.py, test_shape_op.py,
+test_is_empty_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_output
+
+L = fluid.layers
+
+
+def test_sampling_id_distribution():
+    # heavily skewed distribution: sampled ids must track the probabilities
+    probs = np.tile(np.array([[0.8, 0.1, 0.05, 0.05]], "float32"), (512, 1))
+
+    def build(v):
+        return L.sampling_id(v["p"])
+
+    h = OpHarness(build, {"p": probs})
+    (ids,) = h.outputs()
+    ids = np.ravel(np.asarray(ids)).astype(int)
+    assert ids.min() >= 0 and ids.max() <= 3
+    frac0 = (ids == 0).mean()
+    assert 0.7 < frac0 < 0.9, frac0
+
+
+def test_uniform_and_gaussian_batch_size_like():
+    rng = np.random.RandomState(1)
+    ref = rng.randn(7, 3).astype("float32")
+
+    def build(v):
+        u = L.uniform_random_batch_size_like(v["x"], shape=[-1, 5], min=-1.0, max=1.0)
+        g = L.gaussian_random_batch_size_like(v["x"], shape=[-1, 5], mean=0.0, std=1.0)
+        return [u, g]
+
+    h = OpHarness(build, {"x": ref})
+    u, g = (np.asarray(t) for t in h.outputs())
+    assert u.shape == (7, 5) and g.shape == (7, 5)
+    assert u.min() >= -1.0 and u.max() <= 1.0
+    assert abs(g.mean()) < 0.5
+
+
+def test_shape_op():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 6, 2).astype("float32")
+
+    def build(v):
+        return L.shape(v["x"])
+
+    check_output(build, {"x": x}, np.array([4, 6, 2]), rtol=0)
+
+
+def test_increment_and_is_empty():
+    def build(v):
+        c = L.fill_constant(shape=[1], dtype="float32", value=3.0)
+        inc = L.increment(c, value=2.0)
+        empty = L.is_empty(v["x"])
+        return [inc, empty]
+
+    h = OpHarness(build, {"x": np.zeros((1, 1), "float32")})
+    inc, empty = (np.asarray(t) for t in h.outputs())
+    np.testing.assert_allclose(np.ravel(inc), [5.0], rtol=1e-6)
+    assert not bool(np.ravel(empty)[0])
