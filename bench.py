@@ -30,22 +30,41 @@ BASELINE_TOKENS_PER_SEC = 4500.0
 V5E_PEAK_BF16_FLOPS = 197e12  # per chip
 
 
-def _init_backend(retries=3, delay=15.0):
-    """jax.devices() with bounded retry: the TPU tunnel can drop transiently,
-    and one flaky init must not turn the whole round's bench into a stack
-    trace (round-1 failure mode)."""
-    import jax
+def _init_backend(retries=3, delay=15.0, probe_timeout=180.0):
+    """jax.devices() with bounded retry AND a watchdog: a wedged TPU tunnel
+    makes backend init *hang* (not raise), which must still become an error
+    JSON line rather than a silent driver timeout (round-1 failure mode)."""
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            import jax
+
+            devs = jax.devices()
+            result["on_tpu"] = any(
+                d.platform in ("tpu", "axon") or "TPU" in str(d) for d in devs)
+            result["version"] = jax.__version__
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
 
     last = None
     for attempt in range(retries):
-        try:
-            devs = jax.devices()
-            on_tpu = any(d.platform in ("tpu", "axon") or "TPU" in str(d) for d in devs)
-            return jax.__version__, on_tpu
-        except Exception as e:  # noqa: BLE001
-            last = e
-            if attempt < retries - 1:
-                time.sleep(delay * (attempt + 1))
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(probe_timeout)
+        if t.is_alive():
+            # backend init is stuck; the hung thread can't be killed, and a
+            # second jax init attempt in this process would block on the
+            # same lock — give up loudly
+            raise RuntimeError(
+                "backend init hung for %.0fs (TPU tunnel wedged?)" % probe_timeout)
+        if "on_tpu" in result:
+            return result["version"], result["on_tpu"]
+        last = result.pop("error", None)
+        if attempt < retries - 1:
+            time.sleep(delay * (attempt + 1))
     raise RuntimeError("backend init failed after %d attempts: %s" % (retries, last))
 
 

@@ -1,7 +1,6 @@
-"""beam_search single-step op (static-beam contract): flat top-k over the
-accumulated candidate scores with explicit parenthood, vs numpy
-(reference: test_beam_search_op.py; the e2e decode path lives in
-test_transformer_decode.py)."""
+"""beam_search single-step op: K>beam candidate fan-in with accumulated
+scores — complements test_beam_search.py (which covers the K=beam case and
+end_id handling); e2e decode lives in test_transformer_decode.py."""
 import numpy as np
 
 import paddle_tpu as fluid
@@ -41,9 +40,8 @@ def test_beam_search_step_topk():
 
     flat = acc[0].reshape(-1)
     top = np.argsort(-flat)[:2]
-    # elementwise: the (id, score, parent) triples must be the descending
-    # top-k, correctly paired (order within the beam axis is score-desc)
-    order = np.argsort(-got_scores)
-    np.testing.assert_allclose(got_scores[order], flat[top], rtol=1e-4)
-    np.testing.assert_array_equal(got_ids[order], top % 4)
-    np.testing.assert_array_equal(got_parent[order], top // 4)
+    # the op emits survivors in descending score order; assert the exact
+    # (id, score, parent) triples elementwise — no re-sorting
+    np.testing.assert_allclose(got_scores, flat[top], rtol=1e-4)
+    np.testing.assert_array_equal(got_ids, top % 4)
+    np.testing.assert_array_equal(got_parent, top // 4)
