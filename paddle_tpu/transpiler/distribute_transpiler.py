@@ -13,12 +13,18 @@ Splits a single-node training Program into:
   endpoint.  ``Executor.run(pserver_program)`` enters the serve loop exactly
   like the reference.
 
-Sharding is whole-parameter (RoundRobin/HashName over params); the
-reference's slice-level splitting of huge params is NOT replicated — on TPU
-large params live sharded on the device mesh via ParallelExecutor instead,
-and the pserver path is for the sparse/CTR workload.
+Sharding is whole-parameter by default (RoundRobin/HashName over params).
+With ``config.slice_var_up = True`` the reference's ``slice_var_up``
+behavior is replicated: any parameter big enough (>= min_block_size
+elements and >= 2 rows) is split into row slices spread over every
+pserver, so one huge embedding can't hotspot a single endpoint.  Each
+slice gets its own optimizer-op instance and per-slice optimizer state on
+its pserver; the trainer's send slices grads row-wise, and recv
+reassembles the fresh slices.
 """
 from __future__ import annotations
+
+import numpy as np
 
 from ..framework import OpRole, Program, Variable
 from .ps_dispatcher import RoundRobin
@@ -27,7 +33,7 @@ __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
 
 
 class DistributeTranspilerConfig:
-    slice_var_up = False  # whole-param sharding only (see module docstring)
+    slice_var_up = False  # opt-in row-slice sharding of large params
     split_method = RoundRobin
     min_block_size = 8192
 
@@ -70,6 +76,25 @@ class DistributeTranspiler:
         eps = dispatcher.dispatch(params)
         self.param_ep = {p.name: ep for p, ep in zip(params, eps)}
 
+        # slice_var_up: big params -> one row-slice per pserver.
+        # self.param_slices[pname] = [(slice_name, ep, row0, row1), ...]
+        # (unsliced params get a single full-range slice under their own name)
+        n_eps = len(self.pserver_endpoints)
+        self.param_slices = {}
+        for p, ep in zip(params, eps):
+            rows = p.shape[0] if p.shape else 0
+            numel = int(np.prod(p.shape)) if p.shape else 0
+            if (getattr(self.config, "slice_var_up", False) and n_eps > 1
+                    and rows >= n_eps and numel >= self.config.min_block_size):
+                bounds = [int(round(i * rows / n_eps)) for i in range(n_eps + 1)]
+                self.param_slices[p.name] = [
+                    ("%s.block%d" % (p.name, i), self.pserver_endpoints[i],
+                     bounds[i], bounds[i + 1])
+                    for i in range(n_eps) if bounds[i + 1] > bounds[i]
+                ]
+            else:
+                self.param_slices[p.name] = [(p.name, ep, 0, rows)]
+
     # -- trainer side --------------------------------------------------------
     def get_trainer_program(self):
         p = self.origin_program.clone()
@@ -78,10 +103,17 @@ class DistributeTranspiler:
         blk.ops = [op for op in blk.ops if op.attrs.get("op_role") != OpRole.Optimize]
         grad_ep = {}
         param_ep = {}
+        grad_slices = {}   # grad name  -> [(slice_grad_name, ep, r0, r1)]
+        param_slices = {}  # param name -> [(slice_param_name, ep, r0, r1)]
         for param, grad, _op in self.param_opt_ops:
-            ep = self.param_ep[param]
-            grad_ep[grad] = ep
-            param_ep[param] = ep
+            slices = self.param_slices[param]
+            grad_ep[grad] = slices[0][1]
+            param_ep[param] = slices[0][1]
+            param_slices[param] = slices
+            grad_slices[grad] = [
+                (grad if sn == param else sn.replace(param, grad, 1), ep, r0, r1)
+                for sn, ep, r0, r1 in slices
+            ]
         blk.append_op(
             type="send",
             inputs={"X": sorted(grad_ep)},
@@ -90,6 +122,7 @@ class DistributeTranspiler:
                 "epmap": [grad_ep[g] for g in sorted(grad_ep)],
                 "endpoints": self.pserver_endpoints,
                 "sync_mode": self.sync_mode,
+                "slices": grad_slices,
                 "op_role": OpRole.RPC,
             },
         )
@@ -100,41 +133,108 @@ class DistributeTranspiler:
             attrs={
                 "epmap": [param_ep[pn] for pn in sorted(param_ep)],
                 "endpoints": self.pserver_endpoints,
+                "slices": param_slices,
                 "op_role": OpRole.RPC,
             },
         )
         p._bump()
         return p
 
+    def _slice_rename(self, op, pname, gname, slice_idx, sname, r0, r1):  # noqa: C901
+        """Clone an optimize op for one param slice: Param/Grad and every
+        per-param state var get slice names (row-sliced when their leading
+        dim matches the param's); LearningRate stays shared."""
+        src_blk = self.origin_program.global_block()
+        p_var = src_blk.vars[pname]
+        rows = p_var.shape[0]
+        rename = {}
+        shapes = {}
+        for slot, names in list(op.inputs.items()) + list(op.outputs.items()):
+            for n in names:
+                if n in rename or slot == "LearningRate":
+                    continue
+                if n == pname:
+                    rename[n] = sname
+                    shapes[sname] = (r1 - r0,) + tuple(p_var.shape[1:])
+                elif n == gname:
+                    rename[n] = sname if sname == pname else sname.replace(pname, gname, 1)
+                    shapes[rename[n]] = (r1 - r0,) + tuple(p_var.shape[1:])
+                else:  # optimizer accumulator (velocity/moments/beta pows...)
+                    v = src_blk.vars.get(n)
+                    if v is None:
+                        continue
+                    rename[n] = "%s.block%d" % (n, slice_idx)
+                    if v.shape and v.shape[0] == rows:
+                        shapes[rename[n]] = (r1 - r0,) + tuple(v.shape[1:])
+                    else:  # [1]-shaped state (beta pow): per-slice full copy
+                        shapes[rename[n]] = tuple(v.shape) if v.shape else None
+        self._slice_ranges.update(
+            {new: (r0, r1) for orig, new in rename.items()
+             if shapes.get(new) is not None and src_blk.vars.get(orig) is not None
+             and src_blk.vars[orig].shape and src_blk.vars[orig].shape[0] == rows})
+        new_inputs = {s: [rename.get(n, n) for n in ns] for s, ns in op.inputs.items()}
+        new_outputs = {s: [rename.get(n, n) for n in ns] for s, ns in op.outputs.items()}
+        return new_inputs, new_outputs, rename, shapes
+
     # -- pserver side --------------------------------------------------------
     def get_pserver_program(self, endpoint):
-        mine = [(p, g, op) for p, g, op in self.param_opt_ops if self.param_ep[p] == endpoint]
+        self._slice_ranges = {}  # slice var -> (r0, r1) for row-sliced vars
         prog = Program()
         blk = prog.global_block()
         src_blk = self.origin_program.global_block()
 
         opt_block = prog.create_block()
-        needed_vars = set()
+        var_shapes = {}   # var name -> sliced shape (None = copy source shape)
+        var_sources = {}  # var name -> source var name
         grad_names = []
         param_names = []
-        for pname, gname, op in mine:
-            param_names.append(pname)
-            grad_names.append(gname)
-            new_op = opt_block.append_op(
-                type=op.type, inputs=dict(op.inputs), outputs=dict(op.outputs), attrs=dict(op.attrs)
-            )
-            for names in list(op.inputs.values()) + list(op.outputs.values()):
-                needed_vars.update(names)
-        for n in sorted(needed_vars):
-            if n in src_blk.vars:
-                v = src_blk.vars[n]
+        for pname, gname, op in self.param_opt_ops:
+            for idx, (sname, ep, r0, r1) in enumerate(self.param_slices[pname]):
+                if ep != endpoint:
+                    continue
+                if sname == pname:  # whole param, original names
+                    param_names.append(pname)
+                    grad_names.append(gname)
+                    opt_block.append_op(
+                        type=op.type, inputs=dict(op.inputs),
+                        outputs=dict(op.outputs), attrs=dict(op.attrs))
+                    for names in list(op.inputs.values()) + list(op.outputs.values()):
+                        for n in names:
+                            var_shapes.setdefault(n, None)
+                            var_sources.setdefault(n, n)
+                else:
+                    ni, no, rename, shapes = self._slice_rename(
+                        op, pname, gname, idx, sname, r0, r1)
+                    sgname = ni["Grad"][0]
+                    param_names.append(sname)
+                    grad_names.append(sgname)
+                    opt_block.append_op(
+                        type=op.type, inputs=ni, outputs=no, attrs=dict(op.attrs))
+                    for orig, new in rename.items():
+                        var_shapes[new] = shapes.get(new)
+                        var_sources[new] = orig
+                    for names in list(ni.values()) + list(no.values()):
+                        for n in names:
+                            if n not in var_shapes and n in src_blk.vars:
+                                var_shapes[n] = None
+                                var_sources[n] = n
+        for n in sorted(var_shapes):
+            src_name = var_sources[n]
+            if src_name in src_blk.vars:
+                v = src_blk.vars[src_name]
                 blk.create_var(
-                    name=v.name,
-                    shape=v.shape,
+                    name=n,
+                    shape=var_shapes[n] if var_shapes[n] is not None else v.shape,
                     dtype=v.dtype,
                     persistable=(n not in grad_names) and v.persistable,
                 )
         prog.current_block_idx = 0
+        # slice metadata for get_startup_program: slice name -> (source var,
+        # sliced shape or None for an unsliced copy)
+        prog._slice_vars = {
+            n: (var_sources[n], var_shapes[n]) + self._slice_ranges.get(n, (None, None))
+            for n in var_shapes if var_sources[n] != n
+        }
         blk.append_op(
             type="listen_and_serv",
             inputs={},
@@ -160,6 +260,11 @@ class DistributeTranspiler:
         persistables = {
             v.name for v in pserver_program.list_vars() if v.persistable
         }
+        slice_vars = getattr(pserver_program, "_slice_vars", {})
+        by_source = {}
+        for sname, (src_name, shape, r0, r1) in slice_vars.items():
+            if sname in persistables:
+                by_source.setdefault(src_name, []).append((sname, shape, r0, r1))
         p = Program()
         blk = p.global_block()
         src = startup.global_block()
@@ -172,5 +277,27 @@ class DistributeTranspiler:
                             v = src.vars[n]
                             blk.create_var(name=v.name, shape=v.shape, dtype=v.dtype, persistable=True)
                 blk.append_op(type=op.type, inputs=dict(op.inputs), outputs=dict(op.outputs), attrs=dict(op.attrs))
+            # sliced targets: clone the initializer per slice with the slice's
+            # shape (row-sliced init is distributionally identical; constants
+            # are exact)
+            for o in outs:
+                for sname, shape, r0, r1 in by_source.get(o, []):
+                    sv = src.vars.get(o)
+                    if sv is not None and not blk.has_var(sname):
+                        blk.create_var(name=sname, shape=shape or sv.shape,
+                                       dtype=sv.dtype, persistable=True)
+                    attrs = dict(op.attrs)
+                    if shape is not None and "shape" in attrs:
+                        attrs["shape"] = list(shape)
+                    if r0 is not None and "values" in attrs:
+                        # assign_value-style init: the slice gets its own rows
+                        vals = np.asarray(attrs["values"])
+                        if vals.ndim >= 1 and sv is not None and sv.shape and vals.shape[0] == sv.shape[0]:
+                            attrs["values"] = vals[r0:r1]
+                    blk.append_op(
+                        type=op.type, inputs=dict(op.inputs),
+                        outputs={k: [sname if n == o else n for n in ns]
+                                 for k, ns in op.outputs.items()},
+                        attrs=attrs)
         p._bump()
         return p

@@ -229,12 +229,27 @@ def run_trainer_step(executor, program, feed, fetch_list, scope, clients):
     user_fetches = res[: len(fetch_names)]
     grad_vals = dict(zip(grad_names, res[len(fetch_names) :]))
 
-    # group grads per endpoint
+    # group grads per endpoint; with slice_var_up a grad is split row-wise
+    # into the per-pserver slices the transpiler assigned
     epmap = dict(zip(grad_names, send_op.attrs["epmap"]))
+    grad_slices = send_op.attrs.get("slices") or {}
     by_ep = {}
     for g, v in grad_vals.items():
-        by_ep.setdefault(epmap[g], {})[g] = v
+        slices = grad_slices.get(g) or [(g, epmap[g], None, None)]
+        for sname, ep, r0, r1 in slices:
+            part = v if sname == g else np.asarray(v)[r0:r1]
+            by_ep.setdefault(ep, {})[sname] = part
+    fresh_all = {}
     for ep, grads in by_ep.items():
-        fresh = clients[ep].push_pull(grads)
-        scope.vars.update(fresh)
+        fresh_all.update(clients[ep].push_pull(grads))
+    # reassemble sliced params row-wise; whole params pass through
+    param_slices = recv_op.attrs.get("slices") or {}
+    for pname in recv_op.outputs["Out"]:
+        slices = param_slices.get(pname) or [(pname, None, None, None)]
+        if len(slices) == 1 and slices[0][0] == pname:
+            if pname in fresh_all:
+                scope.vars[pname] = fresh_all[pname]
+        else:
+            parts = [fresh_all[sn] for sn, _, _, _ in sorted(slices, key=lambda s: s[2])]
+            scope.vars[pname] = np.concatenate([np.asarray(x) for x in parts], axis=0)
     return user_fetches
