@@ -668,10 +668,43 @@ class StaticRNN:
         if init is None:
             if shape is None or batch_ref is None:
                 raise ValueError("memory needs init or (shape, batch_ref)")
-            init = tensor_layers.fill_constant_batch_size_like(
-                input=batch_ref, shape=[-1] + list(shape[1:]) if shape[0] in (-1,) else list(shape),
-                dtype="float32", value=init_value, input_dim_idx=ref_batch_dim_idx if ref_batch_dim_idx != 1 else 0,
-            )
+            if self.status != StaticRNN.IN_RNN_BLOCK:
+                raise RuntimeError(
+                    "StaticRNN.memory() must be called inside `with rnn.step()`")
+            main = helper.main_program
+            parent_idx = main.current_block().parent_idx
+            if parent_idx < 0:
+                raise RuntimeError("StaticRNN step block has no parent block")
+            # The init is an *input* of the scan: it must live in the parent
+            # block.  A step-scoped batch_ref is mapped back to the outer
+            # [batch, seq, ...] source it was sliced from (batch dim 0); an
+            # outer-block batch_ref is used directly with the caller's
+            # ref_batch_dim_idx.
+            outer_ref = None
+            dim_idx = 0
+            for outer, ipt in self.inputs:
+                if getattr(batch_ref, "name", None) == ipt.name:
+                    outer_ref = outer
+                    break
+            if outer_ref is None:
+                if batch_ref.block is main.current_block():
+                    raise ValueError(
+                        "StaticRNN.memory batch_ref %r is step-scoped but not a "
+                        "step_input slice; pass the step_input var (or an outer "
+                        "variable) so the init can live in the parent block"
+                        % (getattr(batch_ref, "name", batch_ref),))
+                outer_ref = batch_ref
+                dim_idx = ref_batch_dim_idx
+            saved_idx = main.current_block_idx
+            main.current_block_idx = parent_idx
+            try:
+                init = tensor_layers.fill_constant_batch_size_like(
+                    input=outer_ref,
+                    shape=[-1] + list(shape[1:]) if shape[0] in (-1,) else list(shape),
+                    dtype="float32", value=init_value, input_dim_idx=dim_idx,
+                )
+            finally:
+                main.current_block_idx = saved_idx
         mem = helper.main_program.current_block().create_var(
             name=helper.name + "_mem_" + init.name, dtype=init.dtype, shape=init.shape
         )

@@ -1,0 +1,129 @@
+"""Control flow numeric tests: While (lax.while_loop), IfElse, Switch,
+StaticRNN recurrence vs numpy, tensor arrays (reference:
+test_while_op.py, test_ifelse.py, test_switch.py, test_recurrent_op.py,
+test_array_read_write_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+L = fluid.layers
+
+
+def _run(build, feeds=None, fetch=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feeds or {}, fetch_list=fetch)
+
+
+def test_while_accumulates():
+    """while i < 10: s += i*i; i += 1  — pure in-graph loop."""
+
+    def build():
+        i = L.fill_constant(shape=[1], dtype="int64", value=0)
+        s = L.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = L.fill_constant(shape=[1], dtype="int64", value=10)
+        cond = L.less_than(x=i, y=limit)
+        w = L.While(cond=cond)
+        with w.block():
+            sq = L.elementwise_mul(i, i)
+            L.assign(L.elementwise_add(s, sq), s)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=limit, cond=cond)
+        return [s, i]
+
+    s, i = _run(build)
+    assert int(np.ravel(s)[0]) == sum(k * k for k in range(10))
+    assert int(np.ravel(i)[0]) == 10
+
+
+def test_ifelse_mask_merge():
+    xv = np.array([[1.0], [-2.0], [3.0], [-4.0]], "float32")
+
+    def build():
+        x = L.data(name="x", shape=[1], dtype="float32")
+        zero = L.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = L.less_than(x=x, y=zero)
+        ie = L.IfElse(cond)
+        with ie.true_block():
+            xi = ie.input(x)
+            ie.output(L.scale(xi, scale=-10.0))
+        with ie.false_block():
+            xi = ie.input(x)
+            ie.output(L.scale(xi, scale=2.0))
+        (out,) = ie()
+        return [out]
+
+    (out,) = _run(build, {"x": xv})
+    want = np.where(xv < 0, -10 * xv, 2 * xv)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+
+def test_switch_selects_first_true_case():
+    def build():
+        lr = L.create_global_var(shape=[1], value=0.0, dtype="float32",
+                                 persistable=True, name="sw_lr")
+        step = L.fill_constant(shape=[1], dtype="float32", value=7.0)
+        with L.Switch() as switch:
+            with switch.case(L.less_than(step, L.fill_constant(shape=[1], dtype="float32", value=5.0))):
+                L.assign(L.fill_constant(shape=[1], dtype="float32", value=0.1), lr)
+            with switch.case(L.less_than(step, L.fill_constant(shape=[1], dtype="float32", value=10.0))):
+                L.assign(L.fill_constant(shape=[1], dtype="float32", value=0.2), lr)
+            with switch.default():
+                L.assign(L.fill_constant(shape=[1], dtype="float32", value=0.3), lr)
+        return [lr]
+
+    (lr,) = _run(build)
+    np.testing.assert_allclose(np.ravel(lr), [0.2], rtol=1e-6)
+
+
+def test_static_rnn_matches_numpy_recurrence():
+    """h_t = tanh(x_t W + h_{t-1} U): StaticRNN vs a numpy loop."""
+    T, B, D = 4, 2, 3
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, T, D).astype("float32")
+
+    def build():
+        xv = L.data(name="x", shape=[T, D], dtype="float32")  # [B, T, D]
+        rnn = L.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(xv)
+            h_prev = rnn.memory(shape=[-1, D], batch_ref=xt, init_value=0.0)
+            wx = L.fc(xt, size=D, param_attr=fluid.ParamAttr(name="srnn_w"),
+                      bias_attr=False)
+            uh = L.fc(h_prev, size=D, param_attr=fluid.ParamAttr(name="srnn_u"),
+                      bias_attr=False)
+            h = L.tanh(L.elementwise_add(wx, uh))
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()  # [B, T, D]
+        return [out, "srnn_w", "srnn_u"]
+
+    out, w, u = _run(build, {"x": x})
+    out = np.asarray(out)
+    w, u = np.asarray(w), np.asarray(u)
+    h = np.zeros((B, D))
+    for t in range(T):
+        h = np.tanh(x[:, t] @ w + h @ u)
+        np.testing.assert_allclose(out[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_array_write_read_length():
+    def build():
+        arr = L.create_array("float32")
+        i0 = L.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = L.fill_constant(shape=[1], dtype="int64", value=1)
+        a = L.fill_constant(shape=[2], dtype="float32", value=3.0)
+        b = L.fill_constant(shape=[2], dtype="float32", value=5.0)
+        L.array_write(a, i0, array=arr)
+        L.array_write(b, i1, array=arr)
+        n = L.array_length(arr)
+        back = L.array_read(array=arr, i=i1)
+        return [n, back]
+
+    n, back = _run(build)
+    assert int(np.ravel(n)[0]) == 2
+    np.testing.assert_allclose(np.asarray(back), [5.0, 5.0], rtol=1e-6)
