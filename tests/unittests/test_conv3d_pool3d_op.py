@@ -1,0 +1,94 @@
+"""conv3d / conv3d_transpose / pool3d: forward vs direct NumPy volume
+convolutions + grads (reference: test_conv3d_op.py,
+test_conv3d_transpose_op.py, test_pool3d_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpHarness, check_grad, check_output
+
+L = fluid.layers
+
+
+def _np_conv3d(x, w, stride, pad):
+    N, C, D, H, W = x.shape
+    M, _, kd, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0)) + ((pad, pad),) * 3)
+    Do = (D + 2 * pad - kd) // stride + 1
+    Ho = (H + 2 * pad - kh) // stride + 1
+    Wo = (W + 2 * pad - kw) // stride + 1
+    out = np.zeros((N, M, Do, Ho, Wo), np.float64)
+    for n in range(N):
+        for m in range(M):
+            for d in range(Do):
+                for i in range(Ho):
+                    for j in range(Wo):
+                        patch = xp[n, :, d * stride:d * stride + kd,
+                                   i * stride:i * stride + kh,
+                                   j * stride:j * stride + kw]
+                        out[n, m, d, i, j] = (patch * w[m]).sum()
+    return out
+
+
+def test_conv3d_forward_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 4, 5, 5).astype("float32")
+
+    def build(v):
+        return L.conv3d(v["x"], num_filters=3, filter_size=3, stride=1,
+                        padding=1, param_attr=fluid.ParamAttr(name="c3_w"),
+                        bias_attr=False)
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    w = np.asarray(h.scope.vars["c3_w"])
+    np.testing.assert_allclose(np.asarray(got), _np_conv3d(x, w, 1, 1),
+                               rtol=1e-4, atol=1e-4)
+    check_grad(build, {"x": x}, ["x", "c3_w"], rtol=2e-2, atol=3e-3)
+
+
+def test_conv3d_transpose_inverts_stride():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 3, 3, 3).astype("float32")
+
+    def build(v):
+        return L.conv3d_transpose(v["x"], num_filters=2, filter_size=2,
+                                  stride=2, padding=0,
+                                  param_attr=fluid.ParamAttr(name="c3t_w"),
+                                  bias_attr=False)
+
+    h = OpHarness(build, {"x": x})
+    (got,) = h.outputs()
+    got = np.asarray(got)
+    assert got.shape == (1, 2, 6, 6, 6)
+    # non-overlapping stride-2 scatter: each input voxel's contribution is
+    # exactly x * w placed at its block
+    w = np.asarray(h.scope.vars["c3t_w"])  # [in_c, out_c, 2, 2, 2]
+    want = np.zeros((1, 2, 6, 6, 6))
+    for c_in in range(2):
+        for d in range(3):
+            for i in range(3):
+                for j in range(3):
+                    want[0, :, 2 * d:2 * d + 2, 2 * i:2 * i + 2, 2 * j:2 * j + 2] += (
+                        x[0, c_in, d, i, j] * w[c_in]
+                    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    check_grad(build, {"x": x}, ["x", "c3t_w"], rtol=2e-2, atol=3e-3)
+
+
+def test_pool3d_max_avg():
+    rng = np.random.RandomState(2)
+    x = (rng.permutation(2 * 4 * 4 * 4).reshape(1, 2, 4, 4, 4) * 0.09).astype("float32")
+
+    def build_max(v):
+        return L.pool3d(v["x"], pool_size=2, pool_type="max", pool_stride=2)
+
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 6, 3, 5, 7)
+    want = want.reshape(1, 2, 2, 2, 2, 8)
+    check_output(build_max, {"x": x}, want.max(-1), rtol=1e-5)
+    check_grad(build_max, {"x": x}, ["x"])
+
+    def build_avg(v):
+        return L.pool3d(v["x"], pool_size=2, pool_type="avg", pool_stride=2)
+
+    check_output(build_avg, {"x": x}, want.mean(-1), rtol=1e-5)
+    check_grad(build_avg, {"x": x}, ["x"])
