@@ -1,0 +1,219 @@
+"""Numeric coverage for the remaining registered ops without a dedicated
+layer wrapper: bilinear_tensor_product, conv_shift, elementwise_mod,
+elementwise_floordiv, fill_zeros_like, assign_value,
+truncated_gaussian_random, nearest_interp, anchor_generator,
+max_sequence_len, lod_array_length.
+
+References: paddle/fluid/operators/{bilinear_tensor_product,conv_shift,
+elementwise_mod,fill_zeros_like,assign_value,truncated_gaussian_random,
+interpolate,anchor_generator}_op.* and the corresponding
+tests/unittests/test_*_op.py NumPy models.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.lod import LoDArray
+from op_test import OpHarness, check_grad, check_output
+
+L = fluid.layers
+
+
+def _raw(op_type, inputs, attrs=None, dtype="float32", shape=None):
+    """Append a bare op (no layer wrapper exists) and return its Out var."""
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype, shape=shape)
+    helper.append_op(
+        type=op_type,
+        inputs={k: [v] for k, v in inputs.items()},
+        outputs={"Out": [out]},
+        attrs=attrs or {},
+    )
+    return out
+
+
+# -- bilinear_tensor_product -------------------------------------------------
+
+def test_bilinear_tensor_product_forward_and_grad():
+    rng = np.random.RandomState(0)
+    b, m, n, size = 3, 4, 5, 6
+    x = rng.randn(b, m).astype("float32")
+    y = rng.randn(b, n).astype("float32")
+    w = rng.randn(size, m, n).astype("float32")
+
+    def build(v):
+        weight = L.create_parameter(
+            shape=[size, m, n], dtype="float32", name="btp_w",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(w),
+        )
+        return _raw(
+            "bilinear_tensor_product",
+            {"X": v["x"], "Y": v["y"], "Weight": weight},
+            shape=[b, size],
+        )
+
+    want = np.einsum("bm,smn,bn->bs", x, w, y)
+    check_output(build, {"x": x, "y": y}, want, rtol=1e-4, atol=1e-5)
+    check_grad(build, {"x": x, "y": y}, grad_wrt=["x", "y"])
+
+
+# -- conv_shift --------------------------------------------------------------
+
+def test_conv_shift_forward_and_grad():
+    rng = np.random.RandomState(1)
+    b, m, n = 2, 7, 3
+    x = rng.randn(b, m).astype("float32")
+    y = rng.randn(b, n).astype("float32")
+
+    def build(v):
+        return _raw("conv_shift", {"X": v["x"], "Y": v["y"]}, shape=[b, m])
+
+    half = n // 2
+    want = np.zeros((b, m), np.float64)
+    for i in range(m):
+        for j in range(n):
+            want[:, i] += x[:, (i + j - half) % m] * y[:, j]
+    check_output(build, {"x": x, "y": y}, want, rtol=1e-5)
+    check_grad(build, {"x": x, "y": y}, grad_wrt=["x", "y"])
+
+
+# -- elementwise mod / floordiv ----------------------------------------------
+
+def test_elementwise_mod_floordiv_int():
+    # The v0.15 reference has no elementwise_mod/floordiv operators (they
+    # arrived later); these ops are additions, and this repo deliberately
+    # uses floored (Python/jnp) semantics for negatives, not C++ truncation.
+    rng = np.random.RandomState(2)
+    x = rng.randint(-20, 20, size=(4, 5)).astype("int64")
+    y = rng.randint(1, 7, size=(4, 5)).astype("int64")
+
+    def build_mod(v):
+        return _raw("elementwise_mod", {"X": v["x"], "Y": v["y"]},
+                    attrs={"axis": -1}, dtype="int64", shape=[4, 5])
+
+    def build_div(v):
+        return _raw("elementwise_floordiv", {"X": v["x"], "Y": v["y"]},
+                    attrs={"axis": -1}, dtype="int64", shape=[4, 5])
+
+    check_output(build_mod, {"x": x, "y": y}, x % y, rtol=0)
+    check_output(build_div, {"x": x, "y": y}, x // y, rtol=0)
+
+
+# -- fill_zeros_like / assign_value ------------------------------------------
+
+def test_fill_zeros_like_and_assign_value():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype("float32")
+    vals = rng.randn(2, 3).astype("float32")
+
+    def build(v):
+        z = _raw("fill_zeros_like", {"X": v["x"]}, shape=[3, 4])
+        a = _raw("assign_value", {}, shape=[2, 3],
+                 attrs={"values": vals, "dtype": "float32", "shape": [2, 3]})
+        return [z, a]
+
+    h = OpHarness(build, {"x": x})
+    z, a = (np.asarray(t) for t in h.outputs())
+    np.testing.assert_array_equal(z, np.zeros((3, 4), "float32"))
+    np.testing.assert_allclose(a, vals, rtol=1e-6)
+
+
+# -- truncated_gaussian_random -----------------------------------------------
+
+def test_truncated_gaussian_random_statistics():
+    mean, std = 1.5, 0.5
+
+    def build(v):
+        t = _raw("truncated_gaussian_random", {}, shape=[2000],
+                 attrs={"shape": [2000], "mean": mean, "std": std,
+                        "dtype": "float32", "seed": 7})
+        # feed var keeps the program's feed signature non-empty
+        return L.elementwise_add(t, L.reduce_sum(v["x"]) * 0.0)
+
+    h = OpHarness(build, {"x": np.zeros((1,), "float32")})
+    (out,) = h.outputs()
+    out = np.asarray(out)
+    assert out.shape == (2000,)
+    # truncation at mean ± 2 std
+    assert out.min() >= mean - 2 * std - 1e-5
+    assert out.max() <= mean + 2 * std + 1e-5
+    assert abs(out.mean() - mean) < 0.05
+    assert 0.7 * std < out.std() < std
+
+
+# -- nearest_interp ----------------------------------------------------------
+
+def test_nearest_interp_integer_upscale():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+
+    def build(v):
+        return _raw("nearest_interp", {"X": v["x"]},
+                    attrs={"out_h": 8, "out_w": 10}, shape=[2, 3, 8, 10])
+
+    want = np.repeat(np.repeat(x, 2, axis=2), 2, axis=3)
+    check_output(build, {"x": x}, want, rtol=1e-6)
+
+
+# -- anchor_generator --------------------------------------------------------
+
+def test_anchor_generator_vs_numpy():
+    x = np.zeros((1, 8, 2, 3), "float32")
+    sizes, ratios = [32.0, 64.0], [0.5, 1.0]
+    stride, offset = [16.0, 16.0], 0.5
+
+    def build(v):
+        anchors, variances = L.anchor_generator(
+            v["x"], anchor_sizes=sizes, aspect_ratios=ratios,
+            stride=stride, offset=offset,
+        )
+        return [anchors, variances]
+
+    h = OpHarness(build, {"x": x})
+    anchors, variances = (np.asarray(t) for t in h.outputs())
+    H, W, A = 2, 3, len(sizes) * len(ratios)
+    assert anchors.shape == (H, W, A, 4)
+    want = np.zeros((H, W, A, 4))
+    for hh in range(H):
+        for ww in range(W):
+            cx, cy = (ww + offset) * stride[0], (hh + offset) * stride[1]
+            k = 0
+            for r in ratios:
+                for s in sizes:
+                    aw, ah = s * np.sqrt(r), s / np.sqrt(r)
+                    want[hh, ww, k] = [cx - aw / 2, cy - ah / 2, cx + aw / 2, cy + ah / 2]
+                    k += 1
+    np.testing.assert_allclose(anchors, want, rtol=1e-5)
+    np.testing.assert_allclose(
+        variances.reshape(-1, 4), np.tile([0.1, 0.1, 0.2, 0.2], (H * W * A, 1)),
+        rtol=1e-6,
+    )
+
+
+# -- max_sequence_len / lod_array_length -------------------------------------
+
+def test_max_sequence_len_from_rank_table():
+    data = np.arange(24, dtype="float32").reshape(3, 4, 2)
+    lengths = np.array([2, 4, 1], "int32")
+    feed = LoDArray(data, lengths)
+
+    def build(v):
+        table = L.lod_rank_table(v["x"])
+        return L.max_sequence_len(table)
+
+    check_output(build, {"x": feed}, np.array([4], "int64"), rtol=0)
+
+
+def test_lod_array_length():
+    def build(v):
+        arr = L.create_array("float32")
+        i = L.fill_constant(shape=[1], dtype="int64", value=0)
+        L.array_write(v["x"], i, array=arr)
+        i2 = L.increment(i, value=1.0, in_place=False)
+        L.array_write(v["x"], i2, array=arr)
+        return L.array_length(arr)
+
+    x = np.ones((2, 3), "float32")
+    check_output(build, {"x": x}, np.array([2], "int64"), rtol=0)

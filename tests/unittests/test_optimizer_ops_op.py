@@ -1,0 +1,268 @@
+"""Numeric update-rule checks for every optimizer op (reference:
+paddle/fluid/operators/{sgd,momentum,adam,adagrad,adamax,adadelta,rmsprop,
+ftrl,decayed_adagrad}_op.h update math, driven through this repo's public
+``fluid.optimizer.*`` API).
+
+Each case trains one parameter whose gradient we control exactly
+(loss = sum(param * feed) so dL/dparam = feed), runs several steps, and
+compares the parameter trajectory against an independent NumPy
+re-implementation of the published update rule, including accumulator
+initial values (Beta1Pow/Beta2Pow start at beta1/beta2, everything else
+at zero — mirroring optimizer.py's _create_accumulators).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+SHAPE = (4, 3)
+STEPS = 4
+
+
+def _run_trajectory(make_opt, grads, p0, after_minimize=None):
+    """Run one optimizer step per grad; return (per-step param values,
+    scope, exe, extra) where extra is ``after_minimize()``'s result, built
+    inside the same program guard (e.g. a ModelAverage)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        g = fluid.layers.data(name="g", shape=[SHAPE[1]], dtype="float32")
+        w = fluid.layers.create_parameter(
+            shape=list(SHAPE),
+            dtype="float32",
+            name="w",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(p0),
+        )
+        loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(w, g))
+        make_opt().minimize(loss)
+        extra = after_minimize() if after_minimize else None
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for g_t in grads:
+            exe.run(main, feed={"g": g_t}, fetch_list=[loss])
+            out.append(np.array(scope.vars["w"], dtype=np.float64))
+    return out, scope, exe, extra
+
+
+def _check(make_opt, numpy_step, state, seed=0, rtol=1e-5, atol=1e-7):
+    rng = np.random.RandomState(seed)
+    p0 = rng.uniform(-1, 1, SHAPE).astype("float32")
+    grads = [rng.uniform(-1, 1, SHAPE).astype("float32") for _ in range(STEPS)]
+    got, _, _, _ = _run_trajectory(make_opt, grads, p0)
+    p = p0.astype(np.float64)
+    for t in range(STEPS):
+        p = numpy_step(p, grads[t].astype(np.float64), state)
+        np.testing.assert_allclose(
+            got[t], p, rtol=rtol, atol=atol,
+            err_msg="parameter diverged from the NumPy rule at step %d" % t,
+        )
+
+
+def test_sgd():
+    lr = 0.1
+
+    def step(p, g, s):
+        return p - lr * g
+
+    _check(lambda: fluid.optimizer.SGD(learning_rate=lr), step, {})
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_momentum(nesterov):
+    lr, mu = 0.05, 0.9
+
+    def step(p, g, s):
+        v = s.setdefault("v", np.zeros(SHAPE))
+        v = mu * v + g
+        s["v"] = v
+        if nesterov:
+            return p - (g + mu * v) * lr
+        return p - lr * v
+
+    _check(
+        lambda: fluid.optimizer.Momentum(
+            learning_rate=lr, momentum=mu, use_nesterov=nesterov
+        ),
+        step,
+        {},
+    )
+
+
+def test_adagrad():
+    lr, eps = 0.3, 1e-6
+
+    def step(p, g, s):
+        m = s.setdefault("m", np.zeros(SHAPE)) + g * g
+        s["m"] = m
+        return p - lr * g / (np.sqrt(m) + eps)
+
+    _check(lambda: fluid.optimizer.Adagrad(learning_rate=lr, epsilon=eps), step, {})
+
+
+def test_decayed_adagrad():
+    lr, decay, eps = 0.3, 0.95, 1e-6
+
+    def step(p, g, s):
+        m = decay * s.setdefault("m", np.zeros(SHAPE)) + (1 - decay) * g * g
+        s["m"] = m
+        return p - lr * g / (np.sqrt(m) + eps)
+
+    _check(
+        lambda: fluid.optimizer.DecayedAdagrad(
+            learning_rate=lr, decay=decay, epsilon=eps
+        ),
+        step,
+        {},
+    )
+
+
+def test_adam():
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+
+    def step(p, g, s):
+        m = b1 * s.setdefault("m", np.zeros(SHAPE)) + (1 - b1) * g
+        v = b2 * s.setdefault("v", np.zeros(SHAPE)) + (1 - b2) * g * g
+        b1p = s.setdefault("b1p", b1)
+        b2p = s.setdefault("b2p", b2)
+        lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+        s.update(m=m, v=v, b1p=b1p * b1, b2p=b2p * b2)
+        return p - lr_t * m / (np.sqrt(v) + eps)
+
+    # f32 accumulator rounding compounds through sqrt(v); 1e-3 still
+    # catches any real formula error (wrong beta/bias-correction is >1e-2)
+    _check(
+        lambda: fluid.optimizer.Adam(
+            learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps
+        ),
+        step,
+        {},
+        rtol=1e-3, atol=1e-6,
+    )
+
+
+def test_adamax():
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+
+    def step(p, g, s):
+        m = b1 * s.setdefault("m", np.zeros(SHAPE)) + (1 - b1) * g
+        n = np.maximum(b2 * s.setdefault("n", np.zeros(SHAPE)), np.abs(g))
+        b1p = s.setdefault("b1p", b1)
+        new_p = p - (lr / (1 - b1p)) * m / (n + eps)
+        # _finish_update scales Beta1Pow after the param update
+        s.update(m=m, n=n, b1p=b1p * b1)
+        return new_p
+
+    _check(
+        lambda: fluid.optimizer.Adamax(
+            learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps
+        ),
+        step,
+        {},
+    )
+
+
+def test_adadelta():
+    rho, eps = 0.95, 1e-2
+
+    def step(p, g, s):
+        g2 = rho * s.setdefault("g2", np.zeros(SHAPE)) + (1 - rho) * g * g
+        u2_prev = s.setdefault("u2", np.zeros(SHAPE))
+        upd = np.sqrt(u2_prev + eps) / np.sqrt(g2 + eps) * g
+        s.update(g2=g2, u2=rho * u2_prev + (1 - rho) * upd * upd)
+        return p - upd
+
+    _check(
+        lambda: fluid.optimizer.Adadelta(
+            learning_rate=1.0, rho=rho, epsilon=eps
+        ),
+        step,
+        {},
+    )
+
+
+@pytest.mark.parametrize("centered,momentum", [(False, 0.0), (False, 0.9), (True, 0.9)])
+def test_rmsprop(centered, momentum):
+    lr, rho, eps = 0.05, 0.95, 1e-6
+
+    def step(p, g, s):
+        ms = rho * s.setdefault("ms", np.zeros(SHAPE)) + (1 - rho) * g * g
+        mom_prev = s.setdefault("mom", np.zeros(SHAPE))
+        if centered:
+            mg = rho * s.setdefault("mg", np.zeros(SHAPE)) + (1 - rho) * g
+            mom = momentum * mom_prev + lr * g / np.sqrt(ms - mg * mg + eps)
+            s["mg"] = mg
+        else:
+            mom = momentum * mom_prev + lr * g / np.sqrt(ms + eps)
+        s.update(ms=ms, mom=mom)
+        return p - mom
+
+    _check(
+        lambda: fluid.optimizer.RMSProp(
+            learning_rate=lr, rho=rho, epsilon=eps,
+            momentum=momentum, centered=centered,
+        ),
+        step,
+        {},
+    )
+
+
+@pytest.mark.parametrize("l1,l2,lr_power", [(0.0, 0.0, -0.5), (0.1, 0.2, -0.5), (0.1, 0.2, -0.3)])
+def test_ftrl(l1, l2, lr_power):
+    lr = 0.5
+
+    def step(p, g, s):
+        sq = s.setdefault("sq", np.zeros(SHAPE))
+        lin = s.setdefault("lin", np.zeros(SHAPE))
+        new_sq = sq + g * g
+        if lr_power == -0.5:
+            sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / lr
+            denom = np.sqrt(new_sq) / lr + 2 * l2
+        else:
+            sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+            denom = new_sq ** (-lr_power) / lr + 2 * l2
+        new_lin = lin + g - sigma * p
+        pre = np.clip(new_lin, -l1, l1) - new_lin
+        new_p = np.where(np.abs(new_lin) > l1, pre / denom, np.zeros_like(p))
+        s.update(sq=new_sq, lin=new_lin)
+        return new_p
+
+    # sq**(-lr_power) with sq==0 yields 0**0.3 == 0; keep the first step's
+    # pre-accumulator zero exactly like the op does.
+    _check(
+        lambda: fluid.optimizer.Ftrl(
+            learning_rate=lr, l1=l1, l2=l2, lr_power=lr_power
+        ),
+        step,
+        {},
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_model_average_accumulates_running_sum():
+    """ModelAverage's average_accumulate op: apply() must swap in the mean
+    of the parameter's post-step values, restore() must swap back."""
+    rng = np.random.RandomState(3)
+    p0 = np.full(SHAPE, 0.5, "float32")
+    grads = [rng.uniform(-1, 1, SHAPE).astype("float32") for _ in range(STEPS)]
+    history, scope, exe, avg = _run_trajectory(
+        lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        grads,
+        p0,
+        after_minimize=lambda: fluid.optimizer.ModelAverage(
+            0.15, min_average_window=1, max_average_window=100
+        ),
+    )
+    with fluid.scope_guard(scope):
+        with avg.apply(exe):
+            np.testing.assert_allclose(
+                np.array(scope.vars["w"], dtype=np.float64),
+                np.mean(history, axis=0),
+                rtol=1e-5,
+            )
+        np.testing.assert_allclose(
+            np.array(scope.vars["w"], dtype=np.float64), history[-1], rtol=1e-7
+        )
