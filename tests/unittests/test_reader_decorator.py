@@ -1,0 +1,142 @@
+"""Reader decorator semantics (reference surface:
+python/paddle/reader/decorator.py and its tests/test_decorator.py):
+cache replay, chain, compose alignment, xmap ordered/unordered,
+multiprocess interleave, buffered prefetch."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from paddle_tpu import reader
+
+D = reader.decorator
+
+
+def _creator(seq):
+    return lambda: iter(list(seq))
+
+
+def test_cache_replays_and_reads_source_once():
+    pulls = []
+
+    def source():
+        pulls.append(1)
+        yield from range(5)
+
+    cached = D.cache(source)
+    assert list(cached()) == list(range(5))
+    assert list(cached()) == list(range(5))
+    assert len(pulls) == 1
+
+
+def test_chain_concatenates():
+    r = D.chain(_creator([1, 2]), _creator([3]), _creator([4, 5]))
+    assert list(r()) == [1, 2, 3, 4, 5]
+
+
+def test_compose_flattens_tuples_and_checks_alignment():
+    r = D.compose(_creator([(1, 2), (3, 4)]), _creator([5, 6]))
+    assert list(r()) == [(1, 2, 5), (3, 4, 6)]
+
+    misaligned = D.compose(_creator([1, 2, 3]), _creator([4]))
+    with pytest.raises(D.ComposeNotAligned):
+        list(misaligned())
+
+    # unchecked composition stops at the shortest reader
+    loose = D.compose(_creator([1, 2, 3]), _creator([4]), check_alignment=False)
+    assert list(loose()) == [(1, 4)]
+
+
+def test_shuffle_is_a_permutation():
+    r = D.shuffle(_creator(range(100)), buf_size=17)
+    assert sorted(r()) == list(range(100))
+
+
+def test_firstn_truncates():
+    assert list(D.firstn(_creator(range(50)), 3)()) == [0, 1, 2]
+
+
+def test_buffered_preserves_order():
+    assert list(D.buffered(_creator(range(20)), size=4)()) == list(range(20))
+
+
+@pytest.mark.parametrize("order", [True, False])
+def test_xmap_maps_everything(order):
+    r = D.xmap_readers(lambda x: x * x, _creator(range(30)), 4, 8, order=order)
+    got = list(r())
+    if order:
+        assert got == [x * x for x in range(30)]
+    else:
+        assert sorted(got) == [x * x for x in range(30)]
+
+
+def test_xmap_ordered_despite_skewed_latency():
+    def slow_for_evens(x):
+        if x % 2 == 0:
+            time.sleep(0.02)
+        return -x
+
+    r = D.xmap_readers(slow_for_evens, _creator(range(12)), 4, 4, order=True)
+    assert list(r()) == [-x for x in range(12)]
+
+
+def test_xmap_propagates_mapper_errors():
+    def boom(x):
+        if x == 3:
+            raise ValueError("bad sample")
+        return x
+
+    r = D.xmap_readers(boom, _creator(range(6)), 2, 2, order=True)
+    with pytest.raises(ValueError, match="bad sample"):
+        list(r())
+
+
+def test_shuffle_degenerate_window_is_passthrough():
+    # buf_size 0 / negative must not silently produce an empty dataset
+    assert sorted(D.shuffle(_creator(range(8)), 0)()) == list(range(8))
+    assert sorted(D.shuffle(_creator(range(8)), -3)()) == list(range(8))
+
+
+def test_buffered_propagates_source_errors():
+    def broken():
+        yield 1
+        raise IOError("corrupt shard")
+
+    it = D.buffered(broken, size=2)()
+    assert next(it) == 1
+    with pytest.raises(IOError, match="corrupt shard"):
+        list(it)
+
+
+def test_multiprocess_reader_propagates_source_errors():
+    def broken():
+        raise IOError("dead reader")
+        yield  # pragma: no cover
+
+    with pytest.raises(IOError, match="dead reader"):
+        list(D.multiprocess_reader([_creator(range(3)), broken])())
+
+
+def test_xmap_abandoned_early_does_not_block_on_window():
+    def slow_after_first(x):
+        if x > 0:
+            time.sleep(5.0)
+        return x
+
+    # big window of very slow mappers: taking one sample and closing the
+    # generator must not wait for the in-flight window to finish
+    r = D.xmap_readers(slow_after_first, _creator(range(64)), 4, 64, order=True)
+    it = r()
+    assert next(it) == 0
+    started = time.monotonic()
+    it.close()
+    assert time.monotonic() - started < 4.0
+
+
+def test_multiprocess_reader_interleaves_all_samples():
+    r = D.multiprocess_reader([_creator(range(10)), _creator(range(10, 20))])
+    assert sorted(r()) == list(range(20))
+
+    with pytest.raises(ValueError):
+        D.multiprocess_reader([])
