@@ -40,6 +40,7 @@ from . import trainer as trainer_mod
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent, save_checkpoint, load_checkpoint)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, InferenceTranspiler, memory_optimize, release_memory
 from . import reader
+from . import recordio_writer
 from .reader import batch
 
 from .core import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace
@@ -55,7 +56,7 @@ from .executor import Executor, Scope, global_scope, scope_guard
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
-from .lod import LoDArray, create_lod_array, create_lod_tensor, create_random_int_lodtensor
+from .lod import LoDArray, LoDTensorArray, create_lod_array, create_lod_tensor, create_random_int_lodtensor
 from .evaluator import Evaluator
 
 create_lod_tensor = create_lod_array
@@ -100,6 +101,7 @@ __all__ = [
     "DataFeeder",
     "LoDArray",
     "LoDTensor",
+    "LoDTensorArray",
     "create_lod_tensor",
     "create_lod_array",
 ]

@@ -3,5 +3,19 @@ from .memory_usage_calc import memory_usage  # noqa: F401
 from . import quantize  # noqa: F401
 from . import mixed_precision  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401
+from . import decoder  # noqa: F401
+from .decoder import BeamSearchDecoder, InitState, StateCell, TrainingDecoder  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401
 
-__all__ = ["memory_usage", "quantize", "mixed_precision", "op_freq_statistic"]
+__all__ = [
+    "memory_usage",
+    "quantize",
+    "mixed_precision",
+    "op_freq_statistic",
+    "decoder",
+    "QuantizeTranspiler",
+    "InitState",
+    "StateCell",
+    "TrainingDecoder",
+    "BeamSearchDecoder",
+]

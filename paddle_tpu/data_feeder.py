@@ -80,3 +80,50 @@ class DataFeeder:
         """Split a batch across places — retained for ParallelExecutor API
         parity; sharding itself is handled by jax (parallel/executor.py)."""
         yield self.feed(iterable)
+
+    def decorate_reader(self, reader, multi_devices, num_places=None, drop_last=True):
+        """Wrap a sample reader into one yielding ready feed dicts
+        (reference data_feeder.py decorate_reader).  With ``multi_devices``
+        each yielded item is a list of per-device dicts, the batch split
+        evenly; an uneven final batch is dropped (``drop_last``) or raises.
+        """
+
+        def split(batch, n):
+            per, rem = divmod(len(batch), n)
+            if rem or per == 0:
+                return None
+            return [self.feed(batch[i * per:(i + 1) * per]) for i in range(n)]
+
+        def decorated():
+            if not multi_devices:
+                for batch in reader():
+                    yield self.feed(batch)
+                return
+            n = num_places
+            if n is None:
+                import jax
+
+                n = jax.device_count()
+            # one-batch lookahead: only the FINAL uneven batch may be
+            # dropped; an uneven batch mid-stream is a config error
+            pending = None
+            for batch in reader():
+                if pending is not None:
+                    fed = split(pending, n)
+                    if fed is None:
+                        raise ValueError(
+                            "batch of %d samples cannot be split across %d "
+                            "devices" % (len(pending), n))
+                    yield fed
+                pending = batch
+            if pending is not None:
+                fed = split(pending, n)
+                if fed is None and not drop_last:
+                    raise ValueError(
+                        "final batch of %d samples cannot be split across %d "
+                        "devices (pass drop_last=True to drop it)"
+                        % (len(pending), n))
+                if fed is not None:
+                    yield fed
+
+        return decorated

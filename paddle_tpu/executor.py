@@ -81,19 +81,46 @@ class Scope:
 
     def __init__(self):
         self.vars: dict[str, object] = {}
+        self.kids: list["Scope"] = []
+        self._parent: "Scope | None" = None
+
+    def new_scope(self) -> "Scope":
+        """Child scope: lookups fall back to this scope (reference
+        Scope::NewScope / FindVar ancestor search)."""
+        kid = Scope()
+        kid._parent = self
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        for kid in self.kids:
+            kid.drop()
+        self.kids.clear()
+
+    def _owner(self, name):
+        scope = self
+        while scope is not None:
+            if name in scope.vars:
+                return scope
+            scope = scope._parent
+        return None
 
     def find_var(self, name):
-        return _VarShim(self, name) if name in self.vars else None
+        owner = self._owner(name)
+        return _VarShim(owner, name) if owner is not None else None
 
     def var(self, name):
         self.vars.setdefault(name, None)
         return _VarShim(self, name)
 
     def __contains__(self, name):
-        return name in self.vars
+        return self._owner(name) is not None
 
     def __getitem__(self, name):
-        return self.vars[name]
+        owner = self._owner(name)
+        if owner is None:
+            raise KeyError(name)
+        return owner.vars[name]
 
     def __setitem__(self, name, value):
         self.vars[name] = value
@@ -102,7 +129,14 @@ class Scope:
         return self.vars.keys()
 
     def drop(self):
+        """Release this scope's vars and its whole subtree (reference Scope
+        destructor semantics); a dropped kid also detaches from its parent
+        so stale handles stop resolving parent names."""
         self.vars.clear()
+        for kid in self.kids:
+            kid.drop()
+        self.kids.clear()
+        self._parent = None
 
 
 _global_scope = Scope()

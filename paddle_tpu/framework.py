@@ -508,6 +508,12 @@ class Program:
         return json.dumps(self.to_dict(), indent=1)
 
     @staticmethod
+    def parse_from_string(s: str) -> "Program":
+        """Inverse of to_string (reference Program.parse_from_string, which
+        round-trips the protobuf desc; here the JSON form)."""
+        return Program.from_dict(json.loads(s))
+
+    @staticmethod
     def from_dict(d) -> "Program":
         p = Program()
         p.blocks = []

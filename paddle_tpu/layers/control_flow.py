@@ -809,6 +809,13 @@ class DynamicRNN:
         ipt = self._rnn.step_input(x)
         return ipt
 
+    def static_input(self, x):
+        """Non-sequence input visible whole at every step (reference
+        control_flow.py DynamicRNN.static_input, which reorders by rank
+        table; padded+masked layout needs no reorder, and outer vars are
+        already readable inside the scan body — pass through)."""
+        return x
+
     def memory(self, init=None, shape=None, value=0.0, need_reorder=False, dtype="float32"):
         return self._rnn.memory(init=init, shape=shape, init_value=value)
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["LoDArray", "create_lod_array", "pack_sequences", "unpack_sequences"]
+__all__ = ["LoDArray", "LoDTensorArray", "create_lod_array", "pack_sequences", "unpack_sequences"]
 
 
 class LoDArray:
@@ -87,6 +87,16 @@ def create_lod_array(data, recursive_seq_lens=None, place=None) -> LoDArray:
         seqs = [data[offs[i]: offs[i + 1]] for i in range(len(lens))]
         return pack_sequences(seqs)
     raise NotImplementedError("nested lod>1 flat construction; pass per-item lists instead")
+
+
+class LoDTensorArray(list):
+    """Growable sequence of LoD tensors (reference: the pybind-bound
+    ``vector<LoDTensor>``; here a plain list with the same ``append``
+    surface, fed to / fetched from array ops)."""
+
+    def append(self, tensor):
+        list.append(self, tensor)
+        return self
 
 
 def create_lod_tensor(data, recursive_seq_lens, place=None):

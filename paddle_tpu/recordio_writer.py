@@ -1,0 +1,61 @@
+"""fluid.recordio_writer parity module (reference:
+python/paddle/fluid/recordio_writer.py).
+
+The single-file converter lives in recordio_io; this module re-exports it
+under the reference's module name and adds the multi-file splitter.
+"""
+from __future__ import annotations
+
+import os
+
+from .recordio_io import (
+    COMPRESS_DEFLATE,
+    COMPRESS_NONE,
+    Writer,
+    convert_reader_to_recordio_file,
+)
+
+__all__ = [
+    "convert_reader_to_recordio_file",
+    "convert_reader_to_recordio_files",
+]
+
+
+def convert_reader_to_recordio_files(
+    filename,
+    batch_per_file,
+    reader_creator,
+    feeder=None,
+    compressor=COMPRESS_DEFLATE,
+    max_num_records=1000,
+    feed_order=None,
+):
+    """Split the reader's samples across numbered recordio files,
+    ``batch_per_file`` samples apiece (filename-00000, filename-00001, ...).
+    Returns the list of files written."""
+    if batch_per_file <= 0:
+        raise ValueError("batch_per_file must be positive, got %d" % batch_per_file)
+    base, written = filename, []
+    writer, in_file = None, 0
+
+    def roll():
+        nonlocal writer, in_file
+        if writer is not None:
+            writer.close()
+        path = "%s-%05d" % (base, len(written))
+        written.append(path)
+        writer = Writer(path, max_num_records, compressor)
+        in_file = 0
+
+    try:
+        for sample in reader_creator():
+            if feeder is not None:
+                sample = feeder.feed([sample])
+            if writer is None or in_file >= batch_per_file:
+                roll()
+            writer.write_sample(sample)
+            in_file += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    return written

@@ -177,6 +177,12 @@ class DistributeTranspiler:
         return new_inputs, new_outputs, rename, shapes
 
     # -- pserver side --------------------------------------------------------
+    def get_pserver_programs(self, endpoint):
+        """(main, startup) pair for one pserver endpoint — the reference's
+        convenience bundling of get_pserver_program + get_startup_program."""
+        main = self.get_pserver_program(endpoint)
+        return main, self.get_startup_program(endpoint, main)
+
     def get_pserver_program(self, endpoint):
         self._slice_ranges = {}  # slice var -> (r0, r1) for row-sliced vars
         prog = Program()

@@ -1,0 +1,205 @@
+"""contrib decoder API: StateCell + TrainingDecoder (teacher-forced train)
+and BeamSearchDecoder (jitted While beam decode).  Reference surface:
+python/paddle/fluid/contrib/decoder/beam_search_decoder.py; reference
+usage: tests/book/high-level-api/machine_translation/."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import BeamSearchDecoder, InitState, StateCell, TrainingDecoder
+
+L = fluid.layers
+
+VOCAB, WORD_DIM, HIDDEN = 12, 8, 16
+BATCH, T = 4, 5
+BEAM, MAX_LEN, END_ID = 2, 6, 1
+
+
+def _rnn_cell_updater(cell):
+    current_word = cell.get_input("x")
+    prev_h = cell.get_state("h")
+    h = L.fc(current_word, size=HIDDEN, act="tanh", name="cell_x2h")
+    h2 = L.fc(prev_h, size=HIDDEN, name="cell_h2h")
+    cell.set_state("h", L.elementwise_add(h, h2))
+
+
+def _build_state_cell(init_h):
+    cell = StateCell(
+        inputs={"x": None},
+        states={"h": InitState(init=init_h)},
+        out_state="h",
+    )
+    cell.state_updater(_rnn_cell_updater)
+    return cell
+
+
+def test_training_decoder_trains_a_copy_task():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 7  # deterministic init: the assertion is on the trajectory
+    with fluid.program_guard(main, startup):
+        src = L.data(name="src", shape=[T], dtype="int64")
+        trg = L.data(name="trg", shape=[T], dtype="int64")
+        src_emb = L.embedding(src, size=[VOCAB, WORD_DIM], dtype="float32")
+        init_h = L.fc(L.reduce_mean(src_emb, dim=1), size=HIDDEN, act="tanh")
+
+        cell = _build_state_cell(init_h)
+        decoder = TrainingDecoder(cell)
+        trg_emb = L.embedding(trg, size=[VOCAB, WORD_DIM], dtype="float32")
+        with decoder.block():
+            word = decoder.step_input(trg_emb)
+            decoder.state_cell.compute_state(inputs={"x": word})
+            score = L.fc(decoder.state_cell.get_state("h"), size=VOCAB,
+                         act="softmax", name="out_proj")
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        probs = decoder()  # [batch, T, VOCAB]
+        lbl = L.reshape(trg, shape=[-1, T, 1])
+        loss = L.reduce_mean(L.cross_entropy(probs, lbl))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = rng.randint(2, VOCAB, size=(BATCH, T)).astype("int64")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={"src": seqs, "trg": seqs}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    # Adam at this lr can spike after converging; the claim is that the
+    # decoder LEARNS, so assert on the best loss reached
+    assert min(losses) < 0.2 * losses[0], (losses[0], min(losses), losses[-1])
+
+
+def test_state_cell_validates_usage():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        boot = L.data(name="boot", shape=[HIDDEN], dtype="float32")
+        with pytest.raises(ValueError):
+            StateCell(inputs={}, states={"h": InitState(init=boot)}, out_state="nope")
+        with pytest.raises(ValueError):
+            StateCell(inputs={}, states={"h": "not-an-initstate"}, out_state="h")
+        cell = StateCell(inputs={"x": None}, states={"h": InitState(init=boot)},
+                         out_state="h")
+        with pytest.raises(ValueError):
+            cell.get_input("x")  # not bound yet
+        with pytest.raises(ValueError):
+            cell.compute_state(inputs={"bogus": boot})
+
+
+def test_read_array_slots_are_loop_carried():
+    """Regression: a read_array slot must accumulate across While steps
+    (a slot created inside the sub-block would reset to its seed every
+    iteration and read back its first write forever)."""
+    main, startup = fluid.Program(), fluid.Program()
+    n_steps = 4
+    with fluid.program_guard(main, startup):
+        boot = L.data(name="boot", shape=[HIDDEN], dtype="float32")
+        cell = _build_state_cell(L.fc(boot, size=HIDDEN, act="tanh"))
+        decoder = BeamSearchDecoder(
+            state_cell=cell,
+            init_ids=L.data(name="ii", shape=[1], dtype="int64"),
+            init_scores=L.data(name="isc", shape=[1], dtype="float32"),
+            target_dict_dim=VOCAB, word_dim=WORD_DIM,
+            max_len=n_steps, beam_size=1, end_id=END_ID,
+        )
+        zero = L.fill_constant(shape=[1, 1], dtype="float32", value=0.0)
+        one = L.fill_constant(shape=[1, 1], dtype="float32", value=1.0)
+        with decoder.block():
+            acc = decoder.read_array(init=zero)
+            decoder.update_array(acc, L.elementwise_add(acc, one))
+        counter_val = acc
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={
+            "boot": np.ones((1, HIDDEN), "float32"),
+            "ii": np.zeros((1, 1), "int64"),
+            "isc": np.zeros((1, 1), "float32"),
+        }, fetch_list=[counter_val])
+    assert float(np.ravel(v)[0]) == float(n_steps), v
+
+
+def test_scope_drop_is_recursive():
+    s = fluid.Scope()
+    kid = s.new_scope()
+    grandkid = kid.new_scope()
+    s["top"] = 1
+    grandkid.vars["deep"] = 2
+    assert "top" in grandkid
+    s.drop_kids()
+    assert "deep" not in grandkid and grandkid.kids == []
+    assert "top" not in grandkid  # dropped kids stop resolving parent names
+
+
+def test_decorate_reader_multi_device_splitting():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = L.data(name="x", shape=[3], dtype="float32")
+        feeder = fluid.DataFeeder([x], fluid.CPUPlace())
+
+    def batches(sizes):
+        return lambda: iter([[(np.ones(3, "float32"),)] * s for s in sizes])
+
+    # final uneven batch dropped; even batches split
+    fed = list(feeder.decorate_reader(batches([4, 4, 3]), True, num_places=2)())
+    assert len(fed) == 2 and all(len(f) == 2 for f in fed)
+    assert fed[0][0]["x"].shape == (2, 3)
+    # mid-stream uneven batch is a config error, not a silent drop
+    with pytest.raises(ValueError):
+        list(feeder.decorate_reader(batches([3, 4]), True, num_places=2)())
+    # final uneven batch with drop_last=False raises
+    with pytest.raises(ValueError):
+        list(feeder.decorate_reader(batches([4, 3]), True, num_places=2,
+                                    drop_last=False)())
+
+
+def test_beam_search_decoder_decodes():
+    rng = np.random.RandomState(1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = L.data(name="src", shape=[T], dtype="int64")
+        init_ids = L.data(name="init_ids", shape=[BEAM], dtype="int64")
+        init_scores = L.data(name="init_scores", shape=[BEAM], dtype="float32")
+
+        src_emb = L.embedding(src, size=[VOCAB, WORD_DIM], dtype="float32")
+        init_h = L.fc(L.reduce_mean(src_emb, dim=1), size=HIDDEN, act="tanh")
+        cell = _build_state_cell(init_h)
+
+        decoder = BeamSearchDecoder(
+            state_cell=cell,
+            init_ids=init_ids,
+            init_scores=init_scores,
+            target_dict_dim=VOCAB,
+            word_dim=WORD_DIM,
+            topk_size=VOCAB,
+            sparse_emb=False,
+            max_len=MAX_LEN,
+            beam_size=BEAM,
+            end_id=END_ID,
+        )
+        decoder.decode()
+        sent_ids, sent_scores = decoder()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        "src": rng.randint(2, VOCAB, size=(BATCH, T)).astype("int64"),
+        "init_ids": np.zeros((BATCH, BEAM), "int64"),
+        "init_scores": np.tile(
+            np.array([[0.0] + [-1e9] * (BEAM - 1)], "float32"), (BATCH, 1)),
+    }
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ids, scores = exe.run(main, feed=feed, fetch_list=[sent_ids, sent_scores])
+        ids2, scores2 = exe.run(main, feed=feed, fetch_list=[sent_ids, sent_scores])
+    ids, scores = np.asarray(ids), np.asarray(scores)
+    assert ids.shape[0] == BATCH and ids.shape[1] == BEAM
+    assert scores.shape[:2] == (BATCH, BEAM)
+    assert ids.min() >= 0 and ids.max() < VOCAB
+    # the top beam must outscore (or tie) the second per batch row
+    assert np.all(scores[:, 0] >= scores[:, 1] - 1e-6)
+    # decode is deterministic under jit
+    np.testing.assert_array_equal(ids, np.asarray(ids2))
+    np.testing.assert_allclose(scores, np.asarray(scores2), rtol=1e-6)
