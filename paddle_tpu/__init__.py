@@ -104,6 +104,23 @@ __all__ = [
     "LoDTensorArray",
     "create_lod_tensor",
     "create_lod_array",
+    "create_random_int_lodtensor",
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "InferenceTranspiler",
+    "memory_optimize",
+    "release_memory",
+    "Trainer",
+    "Inferencer",
+    "CheckpointConfig",
+    "recordio_writer",
+    "contrib",
+    "transpiler",
+    "dataset",
+    "reader",
+    "batch",
+    "debugger",
+    "trainer",
 ]
 
 # `import paddle_tpu.fluid as fluid` parity alias

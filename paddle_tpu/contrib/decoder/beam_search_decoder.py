@@ -323,8 +323,9 @@ class BeamSearchDecoder:
         self._scores_array = layers.create_array("float32", capacity=max_len)
         self._parents_array = layers.create_array("int32", capacity=max_len)
 
-        self._slots = {}       # read_array slots: name -> carried var
-        self._pending = []     # update_array writes applied at step end
+        self._slots = {}          # read_array slots: name -> carried var
+        self._tagged_arrays = {}  # is_ids/is_scores slots -> backtrace array
+        self._pending = []        # update_array writes applied at step end
 
         self._state_cell = state_cell
         self._state_cell._enter_decoder(self)
@@ -369,6 +370,7 @@ class BeamSearchDecoder:
     def early_stop(self):
         """Clear the loop condition (a ``break`` that takes effect at the
         end of this step)."""
+        self._assert_in_decoder_block("early_stop")
         false = layers.fill_constant(shape=[1], dtype="bool", value=0.0)
         layers.assign(false, output=self._cond)
 
@@ -387,19 +389,24 @@ class BeamSearchDecoder:
             slot = layers.assign(init)
         self._slots[slot.name] = slot
         if is_ids:
-            self._ids_slot = slot
+            self._tagged_arrays[slot.name] = self._ids_array
         elif is_scores:
-            self._scores_slot = slot
+            self._tagged_arrays[slot.name] = self._scores_array
         return slot
 
     def update_array(self, array, value):
-        """Schedule ``value`` to become ``array``'s content next step."""
+        """Schedule ``value`` to become ``array``'s content next step.  For
+        a slot tagged is_ids/is_scores, the value is also recorded in the
+        per-step array that feeds the final backtrace."""
         self._assert_in_decoder_block("update_array")
         slot = self._slots.get(array.name)
         if slot is None:
             raise ValueError("update_array target was not made by read_array")
         if not isinstance(value, Variable):
             raise TypeError("value must be a Variable, got %r" % type(value))
+        tagged = self._tagged_arrays.get(array.name)
+        if tagged is not None:
+            layers.array_write(value, i=self._counter, array=tagged)
         self._pending.append((slot, value))
 
     def decode(self):
@@ -451,7 +458,7 @@ class BeamSearchDecoder:
             cur_state = self._state_cell.out_state()                           # [B*beam, H]
             scores = layers.fc(cur_state, size=self._target_dict_dim, act="softmax")
 
-            k = max(beam, min(self._topk_size, self._target_dict_dim))
+            k = max(beam, self._topk_size)  # __init__ clamped to vocab
             topk_scores, topk_ids = layers.topk(scores, k=k)
             topk_scores = layers.reshape(topk_scores, shape=[-1, beam, k])
             topk_ids = layers.reshape(topk_ids, shape=[-1, beam, k])
@@ -462,8 +469,9 @@ class BeamSearchDecoder:
                 prev_ids, prev_scores, topk_ids, acc_scores, beam,
                 end_id=self._end_id)
 
-            layers.array_write(sel_ids, i=self._counter, array=self._ids_array)
-            layers.array_write(sel_scores, i=self._counter, array=self._scores_array)
+            # the is_ids/is_scores-tagged update_array calls below record
+            # sel_ids/sel_scores into the backtrace arrays; parents are the
+            # decoder's own bookkeeping
             layers.array_write(parents, i=self._counter, array=self._parents_array)
 
             # follow the winning lineage: state and carried-context rows

@@ -112,3 +112,17 @@ class QuantizeTranspiler:
                 q, s = quantize_weight_abs_max(w, self.weight_bits)
                 scope.vars[wname] = dequantize_weight_abs_max(q, s, self.weight_bits).astype(w.dtype)
         return program
+
+    def convert_to_int8(self, program, scope, place=None):
+        """Store each quantized weight as its int8 tensor + f32 scale in the
+        scope (reference QuantizeTranspiler.convert_to_int8: the deploy-side
+        representation; freeze_program keeps the dequantized f32 view)."""
+        blk = program.global_block()
+        for op in blk.ops:
+            if op.type == "fake_quantize_abs_max":
+                wname = op.inputs["X"][0]
+                w = np.asarray(scope.vars[wname])
+                q, s = quantize_weight_abs_max(w, self.weight_bits)
+                scope.vars[wname + ".int8"] = q
+                scope.vars[wname + ".scale"] = np.asarray(s, np.float32)
+        return program

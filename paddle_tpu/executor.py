@@ -131,11 +131,15 @@ class Scope:
     def drop(self):
         """Release this scope's vars and its whole subtree (reference Scope
         destructor semantics); a dropped kid also detaches from its parent
-        so stale handles stop resolving parent names."""
+        — both directions, so stale handles stop resolving parent names and
+        the parent's kids list doesn't retain dead scopes."""
         self.vars.clear()
         for kid in self.kids:
+            kid._parent = None  # avoid double-detach walk
             kid.drop()
         self.kids.clear()
+        if self._parent is not None and self in self._parent.kids:
+            self._parent.kids.remove(self)
         self._parent = None
 
 
@@ -583,8 +587,14 @@ class Executor:
             _prof.record("executor.run[prog@%x v%d]" % (id(program), program.version), time.perf_counter() - t0)
         else:
             fetches, new_state, new_key = entry(state_in, feed_arrays, key)
-        scope.vars.update(new_state)
-        scope.vars["__rng_key__"] = new_key
+        # write each updated var back to the scope that owns it (param
+        # updates through a child scope must mutate the parent's param,
+        # as in the reference); new names land in the local scope
+        for name, val in new_state.items():
+            owner = scope._owner(name) or scope
+            owner.vars[name] = val
+        key_owner = scope._owner("__rng_key__") or scope
+        key_owner.vars["__rng_key__"] = new_key
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
@@ -624,16 +634,23 @@ class Executor:
         return out
 
     def _collect_state(self, program, scope):
+        """Persistable vars resolved through the scope's ancestor chain
+        (reference Scope::FindVar), so a new_scope() child sees the
+        parent's parameters."""
         state = {}
         for v in program.list_vars():
-            if v.persistable and v.name in scope.vars and scope.vars[v.name] is not None:
-                state[v.name] = scope.vars[v.name]
+            if not v.persistable:
+                continue
+            owner = scope._owner(v.name)
+            if owner is not None and owner.vars[v.name] is not None:
+                state[v.name] = owner.vars[v.name]
         return state
 
     def _rng_key(self, program, scope):
         import jax
 
-        k = scope.vars.get("__rng_key__")
+        owner = scope._owner("__rng_key__")
+        k = owner.vars["__rng_key__"] if owner is not None else None
         if k is None:
             seed = program.random_seed or np.random.randint(1, 2**31 - 1)
             k = jax.random.PRNGKey(seed)

@@ -45,6 +45,44 @@ class LoDArray:
             lens.append(self.sub_lengths.tolist())
         return lens
 
+    # -- reference LoDTensor method surface (pybind lod_tensor) --------------
+    def set(self, data, place=None):
+        """Replace the payload (reference LoDTensor.set(ndarray, place))."""
+        self.data = np.asarray(data)
+        return self
+
+    def set_recursive_sequence_lengths(self, recursive_seq_lens):
+        levels = [np.asarray(l, np.int32) for l in recursive_seq_lens]
+        self.lengths = levels[-1] if len(levels) == 1 else levels[0]
+        self.sub_lengths = levels[1] if len(levels) > 1 else None
+        return self
+
+    def has_valid_recursive_sequence_lengths(self):
+        """Lengths consistent with the padded payload (the analog of the
+        reference's offset-LoD validation)."""
+        if self.lengths.shape[0] != self.data.shape[0]:
+            return False
+        if self.lengths.size and (self.lengths < 0).any():
+            return False
+        max_len = self.data.shape[1] if self.data.ndim > 1 else 0
+        return not (self.lengths.size and int(self.lengths.max()) > max_len)
+
+    def lod(self):
+        """Offset-style LoD view (reference LoDTensor.lod): cumulative
+        offsets per level, derived from the stored lengths."""
+        out = []
+        for lens in self.recursive_sequence_lengths():
+            offs = [0]
+            for n in lens:
+                offs.append(offs[-1] + int(n))
+            out.append(offs)
+        return out
+
+    def set_lod(self, lod):
+        """Accept offset-style LoD (reference LoDTensor.set_lod)."""
+        lens = [[b - a for a, b in zip(level, level[1:])] for level in lod]
+        return self.set_recursive_sequence_lengths(lens)
+
     def __repr__(self):
         return "LoDArray(shape=%s, dtype=%s, lengths=%s)" % (self.data.shape, self.data.dtype, self.lengths.tolist())
 

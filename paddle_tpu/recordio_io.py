@@ -94,18 +94,26 @@ class PyReader:
             yield pickle.loads(rec)
 
 
-def convert_reader_to_recordio_file(filename, reader_creator, feeder=None, compressor=COMPRESS_DEFLATE, max_num_records=1000):
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None, compressor=COMPRESS_DEFLATE, max_num_records=1000, feed_order=None):
     """Reference: python/paddle/fluid/recordio_writer.py — serialize samples
     from a reader into a recordio file.  If a DataFeeder is given, samples
-    are batches fed through it first."""
+    are batches fed through it first; ``feed_order`` selects and orders the
+    serialized slots (defaults to the feeder's declared order)."""
     cnt = 0
     with Writer(filename, max_num_records, compressor) as w:
         for sample in reader_creator():
-            if feeder is not None:
-                sample = feeder.feed([sample])
-            w.write_sample(sample)
+            w.write_sample(_fed_sample(sample, feeder, feed_order))
             cnt += 1
     return cnt
+
+
+def _fed_sample(sample, feeder, feed_order):
+    """Convert one raw sample via the feeder, keyed/ordered by feed_order."""
+    if feeder is None:
+        return sample
+    fed = feeder.feed([sample])
+    order = feed_order or feeder.feed_names
+    return {name: fed[name] for name in order}
 
 
 def read_batches(filename, shapes, dtypes, pass_num=1):

@@ -12,6 +12,7 @@ from .recordio_io import (
     COMPRESS_DEFLATE,
     COMPRESS_NONE,
     Writer,
+    _fed_sample,
     convert_reader_to_recordio_file,
 )
 
@@ -49,11 +50,9 @@ def convert_reader_to_recordio_files(
 
     try:
         for sample in reader_creator():
-            if feeder is not None:
-                sample = feeder.feed([sample])
             if writer is None or in_file >= batch_per_file:
                 roll()
-            writer.write_sample(sample)
+            writer.write_sample(_fed_sample(sample, feeder, feed_order))
             in_file += 1
     finally:
         if writer is not None:

@@ -70,6 +70,20 @@ def test_qat_transpile_trains():
         assert losses[-1] < losses[0], losses
         t.freeze_program(main, fluid.global_scope())
 
+        # deploy-side int8 export: each quantized weight gets an int8
+        # tensor + f32 scale whose product reconstructs the weight
+        t.convert_to_int8(main, fluid.global_scope())
+        scope = fluid.global_scope()
+        pairs = [n for n in scope.keys() if n.endswith(".int8")]
+        assert len(pairs) == 2, pairs
+        for n in pairs:
+            q = np.asarray(scope[n])
+            s = np.asarray(scope[n[:-5] + ".scale"])
+            w = np.asarray(scope[n[:-5]])
+            assert q.dtype == np.int8
+            deq = fluid.contrib.quantize.dequantize_weight_abs_max(q, s)
+            assert np.abs(deq - w).max() < np.abs(w).max() / 100
+
 
 def test_profiler_report(tmp_path, capsys):
     main, startup, loss = _mlp_program()

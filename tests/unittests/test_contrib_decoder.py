@@ -123,6 +123,54 @@ def test_read_array_slots_are_loop_carried():
     assert float(np.ravel(v)[0]) == float(n_steps), v
 
 
+def test_early_stop_requires_decoder_block():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        boot = L.data(name="boot", shape=[HIDDEN], dtype="float32")
+        cell = _build_state_cell(L.fc(boot, size=HIDDEN))
+        decoder = BeamSearchDecoder(
+            state_cell=cell,
+            init_ids=L.data(name="ii", shape=[1], dtype="int64"),
+            init_scores=L.data(name="isc", shape=[1], dtype="float32"),
+            target_dict_dim=VOCAB, word_dim=WORD_DIM,
+            max_len=3, beam_size=1, end_id=END_ID,
+        )
+        with pytest.raises(ValueError, match="early_stop"):
+            decoder.early_stop()
+
+
+def test_executor_runs_through_child_scope():
+    """A new_scope() child must see the parent's trained parameters and
+    write updates back to the parent (reference FindVar semantics)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[3], dtype="float32")
+        w = L.create_parameter(
+            shape=[4, 3], dtype="float32", name="cw",
+            default_initializer=fluid.initializer.ConstantInitializer(2.0))
+        loss = L.reduce_sum(L.elementwise_mul(w, x))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    parent = fluid.Scope()
+    with fluid.scope_guard(parent):
+        exe.run(startup)
+    kid = parent.new_scope()
+    g = np.ones((4, 3), "float32")
+    with fluid.scope_guard(kid):
+        (lv,) = exe.run(main, feed={"x": g}, fetch_list=[loss])
+    # loss used the parent's w=2.0 init, and the SGD update (w -= 0.5*g)
+    # landed back in the parent scope
+    assert abs(float(np.ravel(lv)[0]) - 2.0 * 12) < 1e-5
+    np.testing.assert_allclose(np.asarray(parent.vars["cw"]), np.full((4, 3), 1.5), rtol=1e-6)
+
+
+def test_scope_drop_detaches_from_parent():
+    s = fluid.Scope()
+    kid = s.new_scope()
+    kid.drop()
+    assert kid not in s.kids
+
+
 def test_scope_drop_is_recursive():
     s = fluid.Scope()
     kid = s.new_scope()

@@ -1,0 +1,52 @@
+"""LoDTensor method-surface parity on LoDArray (reference: the pybind
+LoDTensor bindings — lod/set_lod/set/recursive_sequence_lengths/
+has_valid_recursive_sequence_lengths) plus create_* helpers."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDArray, pack_sequences, unpack_sequences
+
+
+def test_lod_offsets_roundtrip_with_lengths():
+    t = pack_sequences([np.ones(2), np.ones(4), np.ones(1)])
+    assert t.recursive_sequence_lengths() == [[2, 4, 1]]
+    assert t.lod() == [[0, 2, 6, 7]]
+    t.set_lod([[0, 1, 4, 7]])
+    assert t.recursive_sequence_lengths() == [[1, 3, 3]]
+    t.set_recursive_sequence_lengths([[3, 3, 1]])
+    assert t.lod() == [[0, 3, 6, 7]]
+
+
+def test_has_valid_recursive_sequence_lengths():
+    t = pack_sequences([np.ones(2), np.ones(4)])
+    assert t.has_valid_recursive_sequence_lengths()
+    t.set_recursive_sequence_lengths([[2, 5]])  # 5 > padded max_len 4
+    assert not t.has_valid_recursive_sequence_lengths()
+    t.set_recursive_sequence_lengths([[2, 4, 1]])  # batch mismatch
+    assert not t.has_valid_recursive_sequence_lengths()
+
+
+def test_set_replaces_payload():
+    t = pack_sequences([np.ones(2), np.ones(3)])
+    t.set(np.zeros((2, 3)))
+    assert t.shape == (2, 3) and float(t.data.sum()) == 0.0
+    assert t.has_valid_recursive_sequence_lengths()
+
+
+def test_create_lod_tensor_and_unpack():
+    flat = np.arange(6, dtype="float32").reshape(6, 1)
+    t = fluid.create_lod_tensor(flat, [[2, 4]])
+    assert isinstance(t, LoDArray) and t.shape[0] == 2
+    seqs = unpack_sequences(t)
+    np.testing.assert_array_equal(seqs[0], flat[:2])
+    np.testing.assert_array_equal(seqs[1], flat[2:])
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[2, 3, 1]], base_shape=[4], low=1, high=9)
+    assert t.shape == (3, 3, 4)
+    assert t.recursive_sequence_lengths() == [[2, 3, 1]]
+    vals = np.concatenate(unpack_sequences(t), axis=0)
+    assert vals.min() >= 1 and vals.max() <= 9

@@ -86,3 +86,31 @@ def test_convert_reader_to_recordio(tmp_path):
     assert n == 12
     got = list(recordio_io.Reader(p).iter_samples())
     assert len(got) == 12 and got[5][1] == 5
+
+
+def test_convert_with_feeder_respects_feed_order(tmp_path):
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data(name="img", shape=[2], dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+        feeder = fluid.DataFeeder([img, lbl], fluid.CPUPlace())
+
+    def reader():
+        for i in range(6):
+            yield (np.full((2,), i, "float32"), np.array([i], "int64"))
+
+    p = str(tmp_path / "fed.recordio")
+    recordio_io.convert_reader_to_recordio_file(
+        p, reader, feeder=feeder, feed_order=["lbl", "img"])
+    got = list(recordio_io.Reader(p).iter_samples())
+    assert len(got) == 6
+    # slots restricted + ordered per feed_order
+    assert list(got[3].keys()) == ["lbl", "img"]
+    assert int(np.ravel(got[3]["lbl"])[0]) == 3
+
+    files = fluid.recordio_writer.convert_reader_to_recordio_files(
+        str(tmp_path / "fedsplit"), 4, reader, feeder=feeder, feed_order=["img"])
+    assert len(files) == 2
+    first = next(iter(recordio_io.Reader(files[0]).iter_samples()))
+    assert list(first.keys()) == ["img"]
