@@ -139,7 +139,7 @@ def _transformer_train_flops_per_step(batch, seq, n_layer, d, d_inner, vocab):
     return 3 * fwd
 
 
-def bench_transformer(on_tpu):
+def bench_transformer(on_tpu, batch=None, seq=None, metric="transformer_tokens_per_sec_per_chip", iters=30, baseline=BASELINE_TOKENS_PER_SEC):
     import jax
 
     import paddle_tpu as fluid
@@ -147,7 +147,8 @@ def bench_transformer(on_tpu):
     from paddle_tpu.models import transformer as T
 
     # Transformer-base, WMT-scale vocab, bf16 on TPU, flash attention path.
-    batch, seq = (64, 256) if on_tpu else (2, 16)
+    if batch is None or seq is None:
+        batch, seq = (64, 256) if on_tpu else (2, 16)
     n_layer, n_head, d_model, d_inner = (6, 8, 512, 2048) if on_tpu else (2, 2, 32, 64)
     vocab = 30000 if on_tpu else 64
 
@@ -174,16 +175,17 @@ def bench_transformer(on_tpu):
         for name in ("src_word", "trg_word", "lbl_word")
     }
 
-    iters = 30 if on_tpu else 3
+    iters = iters if on_tpu else 3
     dt, _ = _time_steps(jitted, state, feeds, iters)
     tps = batch * seq * iters / dt  # target tokens/sec
 
     out = {
-        "metric": "transformer_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tps, 2),
         "unit": "tokens/sec",
-        "vs_baseline": round(tps / BASELINE_TOKENS_PER_SEC, 3),
     }
+    if baseline is not None:  # no published reference number for some shapes
+        out["vs_baseline"] = round(tps / baseline, 3)
     if on_tpu:
         flops = _transformer_train_flops_per_step(batch, seq, n_layer, d_model, d_inner, vocab)
         out["mfu"] = round((flops / (batch * seq)) * tps / V5E_PEAK_BF16_FLOPS, 4)
@@ -206,14 +208,25 @@ def main():
         result["error"] = "%s: %s" % (type(e).__name__, e)
         traceback.print_exc(file=sys.stderr)
 
-    try:
-        extra = bench_transformer(on_tpu)
-    except Exception as e:  # noqa: BLE001
-        extra = {"metric": "transformer_tokens_per_sec_per_chip", "value": 0.0,
-                 "unit": "tokens/sec", "vs_baseline": 0.0,
-                 "error": "%s: %s" % (type(e).__name__, e)}
-        traceback.print_exc(file=sys.stderr)
-    result["extra_metrics"] = [extra]
+    extras = []
+    for kwargs in (
+        {},  # Transformer-base headline config (batch 64, seq 256)
+        # long-context config: flash attention's O(T) HBM advantage compounds;
+        # no reference baseline exists for this shape (vs_baseline omitted)
+        {"batch": 16, "seq": 1024, "baseline": None,
+         "metric": "transformer_seq1024_tokens_per_sec_per_chip", "iters": 15},
+    ):
+        if kwargs and not on_tpu:
+            continue  # long-seq config is TPU-only (too slow on CPU fallback)
+        try:
+            extras.append(bench_transformer(on_tpu, **kwargs))
+        except Exception as e:  # noqa: BLE001
+            extras.append({
+                "metric": kwargs.get("metric", "transformer_tokens_per_sec_per_chip"),
+                "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
+                "error": "%s: %s" % (type(e).__name__, e)})
+            traceback.print_exc(file=sys.stderr)
+    result["extra_metrics"] = extras
 
     print(json.dumps(result))
 

@@ -93,9 +93,12 @@ class Scope:
         return kid
 
     def drop_kids(self):
-        for kid in self.kids:
+        # detach first: kid.drop() would otherwise remove itself from
+        # self.kids mid-iteration and skip every other kid
+        kids, self.kids = self.kids, []
+        for kid in kids:
+            kid._parent = None
             kid.drop()
-        self.kids.clear()
 
     def _owner(self, name):
         scope = self

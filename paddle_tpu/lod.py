@@ -53,7 +53,10 @@ class LoDArray:
 
     def set_recursive_sequence_lengths(self, recursive_seq_lens):
         levels = [np.asarray(l, np.int32) for l in recursive_seq_lens]
-        self.lengths = levels[-1] if len(levels) == 1 else levels[0]
+        if len(levels) > 2:
+            raise ValueError(
+                "LoDArray supports at most 2 LoD levels, got %d" % len(levels))
+        self.lengths = levels[0]
         self.sub_lengths = levels[1] if len(levels) > 1 else None
         return self
 

@@ -11,13 +11,15 @@ __all__ = ["collective"]
 
 
 def __getattr__(name):
-    # lazy: flash/ring import jax at module import time
-    if name in ("flash_attention", "mha_reference"):
-        from . import flash_attention as fa
+    # lazy: flash/ring import jax at module import time.  NB: must use
+    # importlib, not `from . import X` — the fromlist machinery probes the
+    # package with hasattr, which re-enters this __getattr__ and recurses.
+    import importlib
 
+    if name in ("flash_attention", "mha_reference"):
+        fa = importlib.import_module(__name__ + ".flash_attention")
         return getattr(fa, name)
     if name in ("ring_attention", "ring_attention_sharded"):
-        from . import ring_attention as ra
-
+        ra = importlib.import_module(__name__ + ".ring_attention")
         return getattr(ra, name)
     raise AttributeError(name)

@@ -171,6 +171,21 @@ def test_scope_drop_detaches_from_parent():
     assert kid not in s.kids
 
 
+def test_scope_drop_kids_drops_every_kid():
+    # regression: kid.drop()'s self-detach must not skip every other kid
+    # by mutating the list drop_kids iterates
+    s = fluid.Scope()
+    kids = [s.new_scope() for _ in range(4)]
+    for i, k in enumerate(kids):
+        k.vars["v%d" % i] = i
+    s["p"] = 0
+    s.drop_kids()
+    assert s.kids == []
+    for i, k in enumerate(kids):
+        assert "v%d" % i not in k
+        assert "p" not in k  # detached from parent too
+
+
 def test_scope_drop_is_recursive():
     s = fluid.Scope()
     kid = s.new_scope()

@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.parallel import flash_attention as FA
 from paddle_tpu.parallel.flash_attention import flash_attention, mha_reference
 from paddle_tpu.parallel.ring_attention import ring_attention_sharded
 from paddle_tpu.parallel.collective import make_mesh
@@ -28,7 +29,9 @@ def test_flash_matches_reference(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_grads_match(causal):
+@pytest.mark.parametrize("bwd_impl", ["scan", "pallas"])
+def test_flash_grads_match(causal, bwd_impl, monkeypatch):
+    monkeypatch.setattr(FA, "FLASH_BWD_IMPL", bwd_impl)
     q, k, v = _rand_qkv(T=32, D=8, seed=1)
 
     def loss_flash(q, k, v):
@@ -62,8 +65,8 @@ def test_flash_causal_offset_when_T_ne_S():
 
 
 @pytest.mark.parametrize("causal,with_lens", [(False, False), (True, False), (True, True)])
-def test_flash_lowers_for_tpu(causal, with_lens):
-    """Compile gate: the Pallas kernel must produce a valid Mosaic TPU
+def test_flash_lowers_for_tpu(causal, with_lens, monkeypatch):
+    """Compile gate: the Pallas kernels must produce a valid Mosaic TPU
     module (block specs, scalar prefetch) — lowered cross-platform from the
     CPU test host via jax.export, no TPU execution."""
     B, H, T, D = 2, 4, 256, 64
@@ -75,6 +78,19 @@ def test_flash_lowers_for_tpu(causal, with_lens):
 
     exported = jax.export.export(jax.jit(f), platforms=["tpu"])(q, q, q)
     assert "tpu_custom_call" in exported.mlir_module()
+
+    # the alternative Pallas backward pair (dk/dv + dq kernels) must lower
+    # for TPU as well (the default scan backward is plain XLA)
+    monkeypatch.setattr(FA, "FLASH_BWD_IMPL", "pallas")
+
+    def g(q, k, v):
+        return (flash_attention(q, k, v, lens, causal, None, 128, 128, False)
+                .astype(jnp.float32) ** 2).sum()
+
+    exported_bwd = jax.export.export(
+        jax.jit(jax.grad(g, argnums=(0, 1, 2))), platforms=["tpu"])(q, q, q)
+    # forward + 2 backward pallas_calls
+    assert exported_bwd.mlir_module().count("tpu_custom_call") >= 3
 
 
 def test_flash_uneven_tail_block():

@@ -4,6 +4,7 @@ has_valid_recursive_sequence_lengths) plus create_* helpers."""
 from __future__ import annotations
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.lod import LoDArray, pack_sequences, unpack_sequences
@@ -26,6 +27,12 @@ def test_has_valid_recursive_sequence_lengths():
     assert not t.has_valid_recursive_sequence_lengths()
     t.set_recursive_sequence_lengths([[2, 4, 1]])  # batch mismatch
     assert not t.has_valid_recursive_sequence_lengths()
+
+
+def test_set_recursive_sequence_lengths_rejects_3_levels():
+    t = pack_sequences([np.ones(2), np.ones(4)])
+    with pytest.raises(ValueError, match="at most 2"):
+        t.set_recursive_sequence_lengths([[2], [1, 1], [1, 1]])
 
 
 def test_set_replaces_payload():
