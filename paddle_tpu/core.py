@@ -63,7 +63,20 @@ class TPUPlace(Place):
 
 class CUDAPlace(TPUPlace):
     """Compatibility alias so reference scripts run unmodified: maps to the
-    accelerator backend (TPU here)."""
+    accelerator backend (TPU here).  Warns once so ported scripts can find
+    leftover CUDA-specific placement."""
+
+    _warned = False
+
+    def __init__(self, device_id=0):
+        if not CUDAPlace._warned:
+            import warnings
+
+            warnings.warn(
+                "CUDAPlace maps to the TPU backend in paddle_tpu; use "
+                "TPUPlace() directly", stacklevel=2)
+            CUDAPlace._warned = True
+        super().__init__(device_id)
 
 
 class CUDAPinnedPlace(CPUPlace):
