@@ -234,6 +234,27 @@ def test_metrics_accumulate():
     np.testing.assert_allclose(avg, 0.5)
 
 
+def test_weighted_average():
+    avg = fluid.average.WeightedAverage()
+    with pytest.raises(ValueError):
+        avg.eval()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    assert abs(avg.eval() - 10.0 / 3.0) < 1e-9
+    avg.add(value=np.array([1.0, 3.0]), weight=3)  # arrays contribute their mean
+    assert abs(avg.eval() - 16.0 / 6.0) < 1e-9
+    with pytest.raises(ValueError):
+        avg.add(value="nope", weight=1)
+    with pytest.raises(ValueError):
+        avg.add(value="3.5", weight=1)  # numeric strings rejected too
+    with pytest.raises(ValueError):
+        avg.add(value=1.0, weight="nope")
+    avg.add(value=1.0, weight=np.int64(2))  # numpy scalar weights accepted
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+
+
 def test_reader_decorators_compose():
     from paddle_tpu import reader
 
