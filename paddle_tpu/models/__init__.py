@@ -15,3 +15,5 @@ from . import ocr_crnn_ctc  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import ssd  # noqa: F401
+from . import recommender  # noqa: F401
+from . import label_semantic_roles  # noqa: F401

@@ -1,0 +1,93 @@
+"""Semantic role labeling: stacked bidirectional LSTM + linear-chain CRF
+on CoNLL-05 (reference: python/paddle/fluid/tests/book/
+test_label_semantic_roles.py — db_lstm with 8 feature embeddings, a stack
+of alternating-direction LSTMs, CRF loss, Viterbi decode).
+
+TPU-native notes: each LSTM layer is one `lax.scan` over the padded batch
+(time-major gate matmuls on the MXU, direction flip = array reverse, no
+LoD reorder); the CRF partition function and Viterbi decode are
+log-semiring scans fused into the same step (ops/struct_ops.py), so train
+and decode are each a single XLA computation.
+"""
+from __future__ import annotations
+
+from .. import layers, optimizer as optim
+from ..dataset import conll05
+
+WORD_DIM = 32
+MARK_DIM = 5
+HIDDEN = 128
+DEPTH = 4  # stacked LSTM layers (alternating direction), reference depth=8
+
+
+FEED_NAMES = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2", "mark"]
+
+
+def db_lstm(word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark,
+            word_dict_len, mark_dict_len, depth=DEPTH, hidden_dim=HIDDEN):
+    """Stacked bi-directional LSTM feature tower -> per-step tag scores."""
+    word_slots = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    embs = [
+        layers.embedding(
+            input=w, size=[word_dict_len, WORD_DIM], dtype="float32")
+        for w in word_slots
+    ]
+    embs.append(layers.embedding(
+        input=mark, size=[mark_dict_len, MARK_DIM], dtype="float32"))
+
+    hidden_0 = layers.sums(
+        [layers.fc(input=e, size=hidden_dim, num_flatten_dims=2) for e in embs])
+    lstm_0, _ = layers.dynamic_lstm(
+        input=layers.fc(input=hidden_0, size=hidden_dim * 4, num_flatten_dims=2),
+        size=hidden_dim * 4,
+    )
+
+    inputs = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix = layers.sums([
+            layers.fc(input=inputs[0], size=hidden_dim, num_flatten_dims=2),
+            layers.fc(input=inputs[1], size=hidden_dim, num_flatten_dims=2),
+        ])
+        lstm, _ = layers.dynamic_lstm(
+            input=layers.fc(input=mix, size=hidden_dim * 4, num_flatten_dims=2),
+            size=hidden_dim * 4,
+            is_reverse=(i % 2) == 1,
+        )
+        inputs = [mix, lstm]
+
+    return layers.sums([
+        layers.fc(input=inputs[0], size=conll05.LABEL_VOCAB, num_flatten_dims=2),
+        layers.fc(input=inputs[1], size=conll05.LABEL_VOCAB, num_flatten_dims=2),
+    ])
+
+
+def get_model(lr=1e-2, depth=DEPTH, hidden_dim=HIDDEN):
+    """Build the SRL model; returns a dict with keys
+    ``main``/``startup``/``feeds``/``loss``/``decode``."""
+    import paddle_tpu as fluid
+
+    word_dict_len = len(conll05.get_dict()[0])
+    mark_dict_len = 2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feats = [
+            layers.data(name=n, shape=[1], dtype="int64", lod_level=1)
+            for n in FEED_NAMES
+        ]
+        label = layers.data(name="target", shape=[1], dtype="int64", lod_level=1)
+
+        feature_out = db_lstm(*feats, word_dict_len=word_dict_len,
+                              mark_dict_len=mark_dict_len, depth=depth,
+                              hidden_dim=hidden_dim)
+        crf_cost = layers.linear_chain_crf(
+            input=feature_out, label=label,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        avg_cost = layers.reduce_mean(crf_cost)
+        decode = layers.crf_decoding(
+            input=feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+        optim.SGD(learning_rate=lr).minimize(avg_cost)
+
+    return {"main": main, "startup": startup,
+            "feeds": FEED_NAMES + ["target"],
+            "loss": avg_cost, "decode": decode}

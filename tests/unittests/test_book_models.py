@@ -1,0 +1,83 @@
+"""Book-chapter models: movielens recommender (two-tower cosine regression)
+and CoNLL-05 SRL (stacked bi-LSTM + CRF).  Reference:
+tests/book/test_recommender_system.py and test_label_semantic_roles.py —
+same criterion: a few epochs of training must drive the loss down, and the
+decode path must emit valid tags."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import pack_sequences
+from paddle_tpu.models import label_semantic_roles, recommender
+
+
+def _movielens_batch(samples):
+    """dataset rows -> feed dict (scalars stacked, ragged packed)."""
+    cols = list(zip(*samples))
+    feed = {}
+    for name, col in zip(
+        ["user_id", "gender_id", "age_id", "job_id", "movie_id"], cols[:5]
+    ):
+        feed[name] = np.asarray(col, "int64").reshape(len(samples), 1)
+    feed["category_id"] = pack_sequences(
+        [np.asarray(c, "int64").reshape(-1, 1) for c in cols[5]])
+    feed["movie_title"] = pack_sequences(
+        [np.asarray(t, "int64").reshape(-1, 1) for t in cols[6]])
+    feed["score"] = np.asarray(cols[7], "float32").reshape(len(samples), 1)
+    return feed
+
+
+def test_recommender_trains():
+    model = recommender.get_model(lr=0.02)
+    exe = fluid.Executor(fluid.CPUPlace())
+    reader = fluid.batch(fluid.dataset.movielens.train(), batch_size=32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(model["startup"])
+        losses = []
+        for epoch in range(3):
+            for batch in reader():
+                feed = _movielens_batch(batch)
+                (lv,) = exe.run(model["main"], feed=feed,
+                                fetch_list=[model["loss"]])
+                losses.append(float(np.ravel(lv)[0]))
+        # regression toward the 1-5 rating scale: early loss is O(rating²)
+        first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+        assert last < 0.7 * first, (first, last)
+
+        # inference stays in range
+        (pred,) = exe.run(model["main"], feed=feed, fetch_list=[model["infer"]])
+        pred = np.asarray(pred)
+        assert np.all(pred >= -5.1) and np.all(pred <= 5.1)
+
+
+def test_label_semantic_roles_trains_and_decodes():
+    model = label_semantic_roles.get_model(lr=2e-3, depth=2, hidden_dim=32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    reader = fluid.batch(fluid.dataset.conll05.train(), batch_size=16)
+    names = label_semantic_roles.FEED_NAMES + ["target"]
+
+    def to_feed(batch):
+        cols = list(zip(*batch))
+        return {
+            n: pack_sequences([np.asarray(c, "int64").reshape(-1, 1) for c in col])
+            for n, col in zip(names, cols)
+        }
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(model["startup"])
+        losses = []
+        for epoch in range(2):
+            for batch in reader():
+                feed = to_feed(batch)
+                (lv,) = exe.run(model["main"], feed=feed,
+                                fetch_list=[model["loss"]])
+                losses.append(float(np.ravel(lv)[0]))
+        first, last = np.mean(losses[:3]), np.mean(losses[-3:])
+        assert last < first, (first, last)
+
+        (tags,) = exe.run(model["main"], feed=feed, fetch_list=[model["decode"]])
+        tags = np.asarray(tags)
+        from paddle_tpu.dataset.conll05 import LABEL_VOCAB
+
+        assert tags.min() >= 0 and tags.max() < LABEL_VOCAB

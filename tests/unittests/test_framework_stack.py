@@ -250,6 +250,11 @@ def test_weighted_average():
     with pytest.raises(ValueError):
         avg.add(value=1.0, weight="nope")
     avg.add(value=1.0, weight=np.int64(2))  # numpy scalar weights accepted
+    avg.add(value=1.0, weight=np.array([3.0]))  # fetched size-1 tensor weight
+    zero = fluid.average.WeightedAverage()
+    zero.add(1.0, weight=0.0)
+    with pytest.raises(ValueError, match="zero"):
+        zero.eval()
     avg.reset()
     with pytest.raises(ValueError):
         avg.eval()
