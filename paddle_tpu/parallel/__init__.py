@@ -22,4 +22,7 @@ def __getattr__(name):
     if name in ("ring_attention", "ring_attention_sharded"):
         ra = importlib.import_module(__name__ + ".ring_attention")
         return getattr(ra, name)
+    if name in ("ulysses_attention", "ulysses_attention_sharded"):
+        ul = importlib.import_module(__name__ + ".ulysses")
+        return getattr(ul, name)
     raise AttributeError(name)
