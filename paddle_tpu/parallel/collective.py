@@ -26,7 +26,63 @@ __all__ = [
     "axis_size",
     "make_mesh",
     "device_count",
+    "init_distributed",
+    "shutdown_distributed",
 ]
+
+
+def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """Join this host to the multi-host runtime (the analog of the
+    reference's trainer/pserver endpoint wiring, but for SPMD: after this,
+    ``jax.devices()`` spans every host and mesh axes may cross DCN).
+
+    Arguments default from the reference's trainer environment variables —
+    ``PADDLE_CURRENT_ENDPOINT``'s peer list analog ``PADDLE_COORDINATOR``
+    (host:port of process 0), ``PADDLE_TRAINERS_NUM`` and
+    ``PADDLE_TRAINER_ID`` — so launcher scripts port unchanged.  No-ops on
+    repeat calls.
+    """
+    import os
+
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("PADDLE_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if not 0 <= process_id < num_processes:
+        raise ValueError(
+            "process_id %d out of range for %d processes" % (process_id, num_processes))
+    if num_processes > 1 and not coordinator_address:
+        raise ValueError(
+            "multi-process init needs coordinator_address (or PADDLE_COORDINATOR)")
+    if num_processes == 1 and not coordinator_address:
+        return  # single host, no coordinator requested: nothing to wire up
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # repeat initialization is a documented no-op
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
+            raise
+
+
+def shutdown_distributed():
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError as e:
+        # only the never-initialized case is benign; a failed teardown of a
+        # live multi-host runtime must surface
+        msg = str(e).lower()
+        if "not initialized" not in msg and "initialize" not in msg:
+            raise
 
 
 def psum(x, axis_name):

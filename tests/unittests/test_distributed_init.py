@@ -1,0 +1,47 @@
+"""init_distributed / shutdown_distributed (multi-host runtime wiring,
+SURVEY §2.4).  The actual initialize is process-global, so the happy path
+runs in a subprocess; validation paths run in-process."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.parallel import collective as C
+
+
+def test_validation(monkeypatch):
+    monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
+    with pytest.raises(ValueError, match="out of range"):
+        C.init_distributed(num_processes=2, process_id=5)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        C.init_distributed(num_processes=2, process_id=0)
+    # single host without a coordinator is a documented no-op
+    C.init_distributed()
+
+
+def test_single_process_lifecycle():
+    code = (
+        "from paddle_tpu.parallel import collective as C\n"
+        "C.init_distributed('localhost:12361', 1, 0)\n"
+        "C.init_distributed('localhost:12361', 1, 0)  # repeat: no-op\n"
+        "import jax; assert jax.process_count() == 1\n"
+        "C.shutdown_distributed()\n"
+        "C.shutdown_distributed()\n"
+        "print('LIFECYCLE-OK')\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=180)
+    assert r.returncode == 0, r.stderr
+    assert "LIFECYCLE-OK" in r.stdout
+
+
+def test_env_defaults(monkeypatch):
+    monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "9")
+    with pytest.raises(ValueError, match="out of range"):
+        C.init_distributed()  # id 9 of 4: env values were read
