@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from op_test import check_grad, check_output
+from op_test import OpHarness, check_grad, check_output
 
 L = fluid.layers
 
@@ -134,3 +134,51 @@ def test_cumsum():
     x = rng.randn(3, 5).astype("float32")
     check_output(lambda v: L.cumsum(v["x"], axis=1), {"x": x}, np.cumsum(x, 1), rtol=1e-5)
     check_grad(lambda v: L.cumsum(v["x"], axis=1), {"x": x}, ["x"])
+
+
+def test_cumsum_exclusive_and_reverse():
+    rng = np.random.RandomState(8)
+    x = rng.randn(3, 5).astype("float32")
+
+    def np_cumsum(a, exclusive, reverse):
+        a = a[:, ::-1] if reverse else a
+        c = np.cumsum(a, axis=1)
+        if exclusive:
+            c = c - a
+        return c[:, ::-1] if reverse else c
+
+    for exclusive in (False, True):
+        for reverse in (False, True):
+            def build(v, e=exclusive, r=reverse):
+                return L.cumsum(v["x"], axis=1, exclusive=e, reverse=r)
+
+            check_output(build, {"x": x},
+                         np_cumsum(x.astype(np.float64), exclusive, reverse),
+                         rtol=1e-5)
+            check_grad(build, {"x": x}, ["x"])
+
+
+def test_prelu_all_and_element_modes():
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 3, 4).astype("float32")
+    x = np.where(np.abs(x) < 0.15, 0.5, x).astype("float32")  # off the kink for FD
+
+    def build_all(v):
+        return L.prelu(v["x"], mode="all",
+                       param_attr=fluid.ParamAttr(name="pa_all"))
+
+    h = OpHarness(build_all, {"x": x})
+    alpha = float(np.ravel(np.asarray(h.scope.vars["pa_all"]))[0])
+    np.testing.assert_allclose(
+        np.asarray(h.outputs()[0]), np.where(x > 0, x, alpha * x), rtol=1e-5)
+    check_grad(build_all, {"x": x}, ["x", "pa_all"])
+
+    def build_elem(v):
+        return L.prelu(v["x"], mode="element",
+                       param_attr=fluid.ParamAttr(name="pa_elem"))
+
+    h2 = OpHarness(build_elem, {"x": x})
+    alpha_e = np.asarray(h2.scope.vars["pa_elem"]).reshape(1, 3, 4)
+    np.testing.assert_allclose(
+        np.asarray(h2.outputs()[0]), np.where(x > 0, x, alpha_e * x), rtol=1e-5)
+    check_grad(build_elem, {"x": x}, ["x", "pa_elem"])

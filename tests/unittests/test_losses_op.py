@@ -100,3 +100,39 @@ def test_label_smooth():
 
     want = onehot * 0.9 + 0.1 / 5
     check_output(build, {"y": onehot}, want, rtol=1e-5)
+
+
+def test_label_smooth_with_prior_dist():
+    rng = np.random.RandomState(7)
+    onehot = np.eye(4, dtype="float32")[rng.randint(0, 4, size=5)]
+    prior = np.array([[0.4, 0.3, 0.2, 0.1]], "float32")
+
+    def build(v):
+        prior_var = L.assign(prior)
+        return L.label_smooth(v["y"], prior_dist=prior_var, epsilon=0.2)
+
+    want = onehot * 0.8 + 0.2 * prior
+    check_output(build, {"y": onehot}, want, rtol=1e-5)
+
+
+def test_smooth_l1_with_weights_and_sigma():
+    rng = np.random.RandomState(8)
+    x = rng.randn(4, 3).astype("float32")
+    y = rng.randn(4, 3).astype("float32")
+    iw = rng.uniform(0.5, 1.5, (4, 3)).astype("float32")
+    ow = rng.uniform(0.5, 1.5, (4, 3)).astype("float32")
+    sigma = 2.0
+
+    def build(v):
+        iw_var = L.assign(iw)
+        ow_var = L.assign(ow)
+        return L.smooth_l1(v["x"], v["y"], inside_weight=iw_var,
+                           outside_weight=ow_var, sigma=sigma)
+
+    s2 = sigma * sigma
+    d = (x.astype(np.float64) - y) * iw
+    a = np.abs(d)
+    elem = np.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    want = (elem * ow).sum(axis=1, keepdims=True)
+    check_output(build, {"x": x, "y": y}, want, rtol=1e-5)
+    check_grad(build, {"x": x, "y": y}, grad_wrt=["x", "y"])
