@@ -1,6 +1,8 @@
 """Layer library (reference: python/paddle/fluid/layers/__init__.py)."""
 from . import nn
 from . import io
+from . import device
+from .device import get_places  # noqa: F401
 from . import ops
 from . import tensor
 from . import control_flow

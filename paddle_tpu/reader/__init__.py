@@ -1,4 +1,6 @@
 """Reader composition utilities (reference: python/paddle/reader/__init__.py)."""
+from . import creator  # noqa: F401
+from .creator import np_array, recordio, text_file  # noqa: F401
 from .decorator import (  # noqa: F401
     buffered,
     cache,

@@ -1,0 +1,51 @@
+"""Reader creators (reference surface: python/paddle/reader/creator.py):
+turn an array, a text file, or recordio files into sample readers."""
+from __future__ import annotations
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Yield the rows of an ndarray (batch dim 0) as samples."""
+    import numpy as np
+
+    arr = np.asarray(x)
+
+    def reader():
+        for row in arr:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Yield stripped lines of a text file."""
+
+    def reader():
+        with open(path, "r") as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Yield deserialized samples from recordio file(s) with ``buf_size``
+    read-ahead; ``paths`` is a path, a comma-separated string, or an
+    iterable of paths (materialized so the creator replays every epoch)."""
+    if isinstance(paths, str):
+        paths = [p for p in paths.split(",") if p]
+    else:
+        paths = list(paths)
+
+    def reader():
+        from ..recordio_io import Reader
+
+        for path in paths:
+            # Reader itself picks the native C++ reader when built
+            for sample in Reader(path).iter_samples():
+                yield sample
+
+    from .decorator import buffered
+
+    return buffered(reader, buf_size)

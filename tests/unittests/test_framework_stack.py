@@ -338,3 +338,40 @@ def test_executor_nan_debug_names_offending_op():
                         fetch_list=[z])
     finally:
         exec_mod.set_nan_debug(False)
+
+
+def test_reader_creators():
+    from paddle_tpu.reader import creator
+    from paddle_tpu import recordio_io
+
+    data = np.arange(12).reshape(4, 3)
+    assert [list(r) for r in creator.np_array(data)()] == [list(r) for r in data]
+
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        txt = os.path.join(d, "lines.txt")
+        with open(txt, "w") as f:
+            f.write("alpha\nbeta\ngamma\n")
+        assert list(creator.text_file(txt)()) == ["alpha", "beta", "gamma"]
+
+        rio = os.path.join(d, "c.recordio")
+        recordio_io.convert_reader_to_recordio_file(
+            rio, lambda: iter([np.full((2,), i) for i in range(5)]))
+        back = list(creator.recordio(rio)())
+        assert len(back) == 5 and int(back[3][0]) == 3
+        # generator paths must replay across epochs (materialized)
+        two_epoch = creator.recordio(iter([rio]))
+        assert len(list(two_epoch())) == 5 and len(list(two_epoch())) == 5
+
+
+def test_get_places():
+    places = fluid.layers.get_places()
+    assert len(places) >= 1
+    cpu = fluid.layers.get_places(device_type="cpu")
+    assert len(cpu) >= 1 and all(d.platform == "cpu" for d in cpu)
+    one = fluid.layers.get_places(device_count=1)
+    assert len(one) == 1
+    with pytest.raises(ValueError):
+        fluid.layers.get_places(device_count=0)
+    with pytest.raises(ValueError):
+        fluid.layers.get_places(device_type="quantum")
