@@ -21,7 +21,8 @@ def get_places(device_count=None, device_type=None):
         want = str(device_type).lower()
         if want in ("gpu", "cuda", "tpu"):
             # no silent CPU fallback: scripts branch on this list's length
-            devices = [d for d in devices if d.platform in ("tpu", "axon")]
+            devices = [d for d in devices
+                       if d.platform in ("tpu", "axon", "gpu", "cuda")]
         elif want == "cpu":
             try:
                 devices = list(jax.devices("cpu"))  # explicit backend: the
