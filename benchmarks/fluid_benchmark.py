@@ -5,7 +5,7 @@ Runs any model from the zoo for N timed iterations and reports throughput:
   python benchmarks/fluid_benchmark.py --model resnet50 --batch_size 128
   python benchmarks/fluid_benchmark.py --model transformer --batch_size 64
   models: mnist vgg16 resnet50 se_resnext stacked_dynamic_lstm transformer
-          word2vec deepfm ocr_crnn_ctc ssd
+          word2vec deepfm ocr_crnn_ctc ssd recommender label_semantic_roles
 
 On TPU, image/transformer models run bf16-on-MXU shapes; on CPU shapes are
 shrunk so the run stays quick.  Synthetic data (same as the reference's
@@ -62,6 +62,30 @@ def _synth(model_name, model, batch, rng):
         lab = rng.randint(0, 95, size=(batch, 8)).astype("int64")
         return {"pixel": rng.randn(batch, 1, 48, 384).astype("float32"),
                 "label": LoDArray(lab, lens)}, batch, "images/sec"
+    if model_name == "recommender":
+        # ranges come from the dataset the model sizes its tables with
+        from paddle_tpu.dataset import movielens as ml
+
+        T_cat, T_title = 3, 6
+        lens_c = rng.randint(1, T_cat + 1, size=(batch,)).astype(np.int32)
+        lens_t = rng.randint(2, T_title + 1, size=(batch,)).astype(np.int32)
+        return {"user_id": rng.randint(1, ml.max_user_id() + 1, size=(batch, 1)).astype("int64"),
+                "gender_id": rng.randint(0, 2, size=(batch, 1)).astype("int64"),
+                "age_id": rng.randint(0, 7, size=(batch, 1)).astype("int64"),
+                "job_id": rng.randint(0, ml.max_job_id() + 1, size=(batch, 1)).astype("int64"),
+                "movie_id": rng.randint(1, ml.max_movie_id() + 1, size=(batch, 1)).astype("int64"),
+                "category_id": LoDArray(rng.randint(0, len(ml.movie_categories()), size=(batch, T_cat, 1)).astype("int64"), lens_c),
+                "movie_title": LoDArray(rng.randint(0, len(ml.get_movie_title_dict()), size=(batch, T_title, 1)).astype("int64"), lens_t),
+                "score": rng.randint(1, 6, size=(batch, 1)).astype("float32")}, batch, "samples/sec"
+    if model_name == "label_semantic_roles":
+        T = 20
+        lens = rng.randint(5, T + 1, size=(batch,)).astype(np.int32)
+        def seq():
+            return LoDArray(rng.randint(0, 200, size=(batch, T, 1)).astype("int64"), lens)
+        feeds = {n: seq() for n in ("word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2")}
+        feeds["mark"] = LoDArray(rng.randint(0, 2, size=(batch, T, 1)).astype("int64"), lens)
+        feeds["target"] = LoDArray(rng.randint(0, 11, size=(batch, T, 1)).astype("int64"), lens)
+        return feeds, int(lens.sum()), "tokens/sec"
     if model_name == "ssd":
         G = 8
         lens = rng.randint(1, G, size=(batch,)).astype(np.int32)
@@ -99,6 +123,10 @@ def build(model_name, batch, on_tpu):
             return zoo.ocr_crnn_ctc.get_model()
         if model_name == "ssd":
             return zoo.ssd.get_model()
+        if model_name == "recommender":
+            return zoo.recommender.get_model()
+        if model_name == "label_semantic_roles":
+            return zoo.label_semantic_roles.get_model(depth=2, hidden_dim=64)
     raise ValueError(model_name)
 
 
@@ -115,7 +143,8 @@ def main():
     on_tpu = _on_tpu()
     defaults = {"resnet50": 128, "vgg16": 64, "se_resnext": 64, "transformer": 64,
                 "stacked_dynamic_lstm": 64, "mnist": 256, "word2vec": 512,
-                "deepfm": 512, "ocr_crnn_ctc": 32, "ssd": 16}
+                "deepfm": 512, "ocr_crnn_ctc": 32, "ssd": 16,
+                "recommender": 256, "label_semantic_roles": 64}
     batch = args.batch_size or (defaults.get(args.model, 64) if on_tpu else 4)
     iters = args.iters or (30 if on_tpu else 3)
 

@@ -19,10 +19,14 @@ def get_places(device_count=None, device_type=None):
     devices = list(jax.devices())
     if device_type is not None:
         want = str(device_type).lower()
-        if want in ("gpu", "cuda", "tpu"):
-            # no silent CPU fallback: scripts branch on this list's length
+        if want == "tpu":
+            # no silent substitution: scripts branch on this list's length
+            devices = [d for d in devices if d.platform in ("tpu", "axon")]
+        elif want in ("gpu", "cuda"):
+            # ported CUDA scripts: any accelerator counts (this framework's
+            # accelerator backend is the TPU)
             devices = [d for d in devices
-                       if d.platform in ("tpu", "axon", "gpu", "cuda")]
+                       if d.platform in ("gpu", "cuda", "tpu", "axon")]
         elif want == "cpu":
             try:
                 devices = list(jax.devices("cpu"))  # explicit backend: the
