@@ -620,21 +620,63 @@ class Executor:
         blk = program.global_block()
         for name, val in feed.items():
             if isinstance(val, LoDArray):
-                out[name] = np.asarray(val.data)
+                arr = np.asarray(val.data)
+                if blk.has_var(name):
+                    self._check_feed_shape(name, blk.var(name), arr)
+                out[name] = arr
                 out[name + "@LENGTHS"] = np.asarray(val.lengths)
                 if val.sub_lengths is not None:
                     out[name + "@SUBLENGTHS"] = np.asarray(val.sub_lengths)
             elif isinstance(val, tuple) and len(val) == 2:
-                out[name] = np.asarray(val[0])
+                arr = np.asarray(val[0])
+                if blk.has_var(name):
+                    self._check_feed_shape(name, blk.var(name), arr)
+                out[name] = arr
                 out[name + "@LENGTHS"] = np.asarray(val[1], dtype=np.int32)
             else:
                 arr = np.asarray(val)
                 if blk.has_var(name):
-                    want = blk.var(name).dtype
+                    var = blk.var(name)
+                    want = var.dtype
                     if want is not None and arr.dtype != core.np_dtype(want):
                         arr = arr.astype(core.np_dtype(want))
+                    self._check_feed_shape(name, var, arr)
                 out[name] = arr
         return out
+
+    @staticmethod
+    def _check_feed_shape(name, var, arr):
+        """Match the feed against the declared var shape (dynamic dims are
+        -1) so shape mistakes fail HERE, by name, instead of as a raw XLA
+        dot/conv shape error deep in the traced step.
+
+        Right-aligned comparison honoring the fluid feeding conventions:
+        leading dynamic dims may be omitted (a dense [batch, d] feed to a
+        lod-declared (-1, -1, d) var), a declared trailing unit dim may be
+        squeezed (int label sequences), but the feed may never have MORE
+        dims than declared and every static dim must agree."""
+        declared = var.shape
+        if not declared:
+            return
+
+        def matches(decl):
+            if len(arr.shape) > len(decl):
+                return False
+            for d, a in zip(reversed(decl), reversed(arr.shape)):
+                if d != -1 and int(d) != int(a):
+                    return False
+            # only DYNAMIC leading dims may be omitted
+            return all(d == -1 for d in decl[: len(decl) - len(arr.shape)])
+
+        ok = matches(declared)
+        if not ok and declared[-1] == 1:
+            ok = matches(declared[:-1])
+        if not ok:
+            raise ValueError(
+                "feed %r has shape %s but the program declares %s "
+                "(-1 = any); check the data layer's shape"
+                % (name, tuple(arr.shape), tuple(declared))
+            )
 
     def _collect_state(self, program, scope):
         """Persistable vars resolved through the scope's ancestor chain

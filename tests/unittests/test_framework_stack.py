@@ -375,3 +375,52 @@ def test_get_places():
         fluid.layers.get_places(device_count=0)
     with pytest.raises(ValueError):
         fluid.layers.get_places(device_type="quantum")
+
+
+def test_feed_shape_mismatch_names_the_feed():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="feed 'x' has shape"):
+            exe.run(main, feed={"x": np.ones((2, 4, 4), "float32")}, fetch_list=[y])
+        with pytest.raises(ValueError, match="feed 'x' has shape"):
+            exe.run(main, feed={"x": np.ones((2, 5), "float32")}, fetch_list=[y])
+        # correct shape still fine, any batch dim accepted
+        exe.run(main, feed={"x": np.ones((7, 4), "float32")}, fetch_list=[y])
+
+
+def test_feed_shape_mismatch_on_lod_feeds():
+    from paddle_tpu.lod import pack_sequences
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_pool(
+            fluid.layers.fc(x, size=4, num_flatten_dims=2), pool_type="sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        good = pack_sequences([np.ones((2, 3), "float32"), np.ones((4, 3), "float32")])
+        exe.run(main, feed={"x": good}, fetch_list=[out])
+        bad = pack_sequences([np.ones((2, 5), "float32")])  # per-step width 5 != 3
+        with pytest.raises(ValueError, match="feed 'x' has shape"):
+            exe.run(main, feed={"x": bad}, fetch_list=[out])
+
+
+def test_feed_shape_check_requires_static_leading_dims():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 4], dtype="float32",
+                              append_batch_size=False)
+        out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((3, 4), "float32")}, fetch_list=[out])
+        with pytest.raises(ValueError, match="feed 'x' has shape"):
+            # omitting a STATIC leading dim must not pass
+            exe.run(main, feed={"x": np.ones((4,), "float32")}, fetch_list=[out])
