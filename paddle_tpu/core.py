@@ -83,6 +83,13 @@ class CUDAPinnedPlace(CPUPlace):
     pass
 
 
+class EOFException(Exception):
+    """Raised when a started py_reader pipeline is exhausted (reference:
+    fluid.core.EOFException).  Deliberately NOT a StopIteration subclass:
+    PEP 479 would mutate that into RuntimeError inside generator frames
+    and silently end iterator-driven for-loops."""
+
+
 # ---------------------------------------------------------------------------
 # dtypes
 # ---------------------------------------------------------------------------

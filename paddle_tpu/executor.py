@@ -541,6 +541,31 @@ class Executor:
         scope = scope or global_scope()
         feed = feed or {}
 
+        # started py_reader pipelines feed the step when the caller passes
+        # no feed (the reference's in-graph reader semantics); an exhausted
+        # pipeline raises core.EOFException out of run().  Items are pulled
+        # from EVERY reader before any is consumed so one reader hitting
+        # EOF pushes the others' items back instead of desynchronizing.
+        if not feed:
+            from .layers.io import program_readers
+
+            # every registered reader is consulted: an unstarted one raises
+            # the diagnostic EOF instead of the step failing on missing vars
+            started = program_readers(program)
+            if started:
+                pulled = []
+                try:
+                    for reader in started:
+                        pulled.append((reader, reader.feed_dict()))
+                except Exception:
+                    for reader, item_feed in reversed(pulled):
+                        reader._pushback.appendleft(
+                            tuple(item_feed[n] for n in reader.names))
+                    raise
+                feed = {}
+                for _, item_feed in pulled:
+                    feed.update(item_feed)
+
         # distributed programs: listen_and_serv blocks serving; send/recv
         # trainer programs run compute as one XLA step + host-side RPC round
         op_types = {op.type for op in program.global_block().ops}

@@ -34,12 +34,25 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type
     )
 
 
+import weakref
+
+_PROGRAM_READERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def program_readers(program, create=False):
+    """py_readers bound to ``program`` (empty list if none)."""
+    if create and program not in _PROGRAM_READERS:
+        _PROGRAM_READERS[program] = []
+    return _PROGRAM_READERS.get(program, [])
+
+
 class _PyReader:
     """Host-side prefetch queue bound to feed slots.  ``decorate_paddle_reader``
     / ``start`` / ``reset`` mirror the reference py_reader surface; iteration
     happens in Executor.run via the feeder hook."""
 
     def __init__(self, capacity, shapes, dtypes, lod_levels, names):
+        import collections
         import queue
 
         self.capacity = capacity
@@ -51,6 +64,7 @@ class _PyReader:
         self._reader = None
         self._thread = None
         self._stop = False
+        self._pushback = collections.deque()  # items returned by the executor
         self.vars = None
 
     def decorate_paddle_reader(self, reader):
@@ -82,13 +96,34 @@ class _PyReader:
             while not self.queue.empty():
                 self.queue.get_nowait()
             self._thread.join(timeout=1.0)
+            self._thread = None  # next() before the next start() raises EOF
+        self._pushback.clear()
         self.queue = __import__("queue").Queue(maxsize=self.capacity)
 
     def next(self):
+        from ..core import EOFException
+
+        if self._pushback:
+            return self._pushback.popleft()
+        if self._thread is None:
+            raise EOFException(
+                "py_reader is not started — call start() (again after reset())")
         item = self.queue.get()
         if item is None:
-            raise StopIteration
+            # leave the sentinel in place: a further next() must raise
+            # again instead of blocking on an empty queue forever
+            self.queue.put(None)
+            raise EOFException("py_reader pipeline exhausted")
         return item
+
+    def feed_dict(self):
+        """One prefetched item as a feed dict over this reader's slots."""
+        item = self.next()
+        if len(item) != len(self.names):
+            raise ValueError(
+                "reader produced %d slots, expected %d (%s)"
+                % (len(item), len(self.names), self.names))
+        return dict(zip(self.names, item))
 
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None, use_double_buffer=True):
@@ -106,6 +141,10 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None, use_double_b
         vars_.append(v)
     r = _PyReader(capacity, shapes, dtypes, lod_levels, names)
     r.vars = vars_
+    # registered in a weak side table, NOT as a program attribute: the
+    # reader holds queues/threads that would break Program.clone()'s
+    # deepcopy; clones intentionally start with no readers
+    program_readers(default_main_program(), create=True).append(r)
     return r
 
 

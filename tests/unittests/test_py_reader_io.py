@@ -1,0 +1,147 @@
+"""In-graph reader pipeline: py_reader / open_recordio_file feed
+Executor.run when no feed dict is passed; exhaustion raises
+core.EOFException; reset() allows another pass (reference idiom:
+tests/unittests/test_py_reader_* and the recordio reader book usage)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio_io
+
+L = fluid.layers
+N, DIM = 24, 4
+
+
+def _write_recordio(path, batch=4):
+    rng = np.random.RandomState(0)
+    w = np.array([[1.0], [-2.0], [0.5], [1.5]], "float32")
+
+    def batches():
+        for _ in range(N // batch):
+            x = rng.randn(batch, DIM).astype("float32")
+            yield (x, x @ w)
+
+    recordio_io.convert_reader_to_recordio_file(path, batches)
+
+
+def test_open_recordio_file_trains_without_feed(tmp_path):
+    path = str(tmp_path / "train.recordio")
+    _write_recordio(path)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = L.open_recordio_file(
+            path, shapes=[(-1, DIM), (-1, 1)], lod_levels=[0, 0],
+            dtypes=["float32", "float32"])
+        x, y = L.read_file(reader)
+        pred = L.fc(x, size=1)
+        loss = L.reduce_mean(L.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for epoch in range(2):
+            reader.start()
+            while True:
+                try:
+                    (lv,) = exe.run(main, fetch_list=[loss])
+                except fluid.core.EOFException:
+                    break
+                losses.append(float(np.ravel(lv)[0]))
+            reader.reset()
+        assert len(losses) == 2 * (N // 4)
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+def test_py_reader_decorated_generator():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = L.py_reader(capacity=8, shapes=[(-1, 3)], dtypes=["float32"])
+        (x,) = L.read_file(reader)
+        out = L.reduce_sum(x)
+
+    reader.decorate_paddle_reader(
+        lambda: iter([(np.full((2, 3), i, "float32"),) for i in range(5)]))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        got = []
+        while True:
+            try:
+                (v,) = exe.run(main, fetch_list=[out])
+            except fluid.core.EOFException:
+                break
+            got.append(float(np.ravel(v)[0]))
+    assert got == [i * 6.0 for i in range(5)]
+
+
+def test_py_reader_program_still_clones():
+    """The reader registry must not ride the Program into deepcopy
+    (queues/threads are unpicklable)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = L.py_reader(capacity=4, shapes=[(-1, 2)], dtypes=["float32"])
+        (x,) = L.read_file(reader)
+        L.reduce_sum(x)
+    clone = main.clone(for_test=True)
+    assert len(clone.global_block().ops) == len(main.global_block().ops)
+    from paddle_tpu.layers.io import program_readers
+    assert program_readers(clone) == []  # clones start readerless
+
+
+def test_py_reader_eof_is_sticky_and_reset_requires_start():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = L.py_reader(capacity=4, shapes=[(-1, 2)], dtypes=["float32"])
+        (x,) = L.read_file(reader)
+        out = L.reduce_sum(x)
+    reader.decorate_paddle_reader(
+        lambda: iter([(np.ones((1, 2), "float32"),)]))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        exe.run(main, fetch_list=[out])
+        # repeated post-EOF runs keep raising instead of hanging
+        for _ in range(3):
+            with pytest.raises(fluid.core.EOFException):
+                exe.run(main, fetch_list=[out])
+        reader.reset()
+        # reset without start: diagnostic EOF, not a deadlock
+        with pytest.raises(fluid.core.EOFException, match="not started"):
+            exe.run(main, fetch_list=[out])
+        reader.start()
+        (v,) = exe.run(main, fetch_list=[out])
+        assert float(np.ravel(v)[0]) == 2.0
+
+
+def test_eof_exception_passes_through_generator_frames():
+    """Plain-Exception EOF: PEP 479 must not swallow it in generators."""
+    def gen():
+        yield 1
+        raise fluid.core.EOFException("done")
+
+    g = gen()
+    assert next(g) == 1
+    with pytest.raises(fluid.core.EOFException):
+        next(g)
+
+
+def test_py_reader_explicit_feed_still_wins():
+    """A passed feed dict bypasses the pipeline entirely."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = L.py_reader(capacity=4, shapes=[(-1, 2)], dtypes=["float32"])
+        (x,) = L.read_file(reader)
+        out = L.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={reader.names[0]: np.ones((3, 2), "float32")},
+                       fetch_list=[out])
+    assert float(np.ravel(v)[0]) == 6.0
