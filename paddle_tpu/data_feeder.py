@@ -77,9 +77,28 @@ class DataFeeder:
         return {name: conv.done() for name, conv in zip(self.feed_names, converters)}
 
     def feed_parallel(self, iterable, num_places=None):
-        """Split a batch across places — retained for ParallelExecutor API
-        parity; sharding itself is handled by jax (parallel/executor.py)."""
-        yield self.feed(iterable)
+        """Yield one feed dict per place, the batch split evenly across
+        them (reference data_feeder.py feed_parallel).  Under the jax
+        ParallelExecutor the mesh shards a single dict itself, so
+        num_places None/1 degenerates to one full-batch dict."""
+        n = num_places
+        if n is not None and n < 1:
+            raise ValueError("num_places must be >= 1, got %r" % n)
+        if n is None or n == 1:
+            yield self.feed(iterable)
+            return
+        yield from self._split_even(list(iterable), n)
+
+    def _split_even(self, batch, n):
+        """Feed dicts for an even n-way split (shared by feed_parallel and
+        decorate_reader; raises if the batch doesn't divide)."""
+        per, rem = divmod(len(batch), n)
+        if rem or per == 0:
+            raise ValueError(
+                "batch of %d samples cannot be split across %d places"
+                % (len(batch), n))
+        for i in range(n):
+            yield self.feed(batch[i * per:(i + 1) * per])
 
     def decorate_reader(self, reader, multi_devices, num_places=None, drop_last=True):
         """Wrap a sample reader into one yielding ready feed dicts
@@ -89,10 +108,10 @@ class DataFeeder:
         """
 
         def split(batch, n):
-            per, rem = divmod(len(batch), n)
-            if rem or per == 0:
-                return None
-            return [self.feed(batch[i * per:(i + 1) * per]) for i in range(n)]
+            try:
+                return list(self._split_even(batch, n))
+            except ValueError:
+                return None  # caller decides drop vs raise for this batch
 
         def decorated():
             if not multi_devices:

@@ -424,3 +424,20 @@ def test_feed_shape_check_requires_static_leading_dims():
         with pytest.raises(ValueError, match="feed 'x' has shape"):
             # omitting a STATIC leading dim must not pass
             exe.run(main, feed={"x": np.ones((4,), "float32")}, fetch_list=[out])
+
+
+def test_feed_parallel_splits_per_place():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        feeder = fluid.DataFeeder([x], fluid.CPUPlace())
+    batch = [(np.full(3, i, "float32"),) for i in range(6)]
+    parts = list(feeder.feed_parallel(batch, num_places=3))
+    assert len(parts) == 3 and all(p["x"].shape == (2, 3) for p in parts)
+    assert float(parts[2]["x"][0, 0]) == 4.0  # third place gets samples 4,5
+    # degenerate: one place = one full dict
+    (whole,) = feeder.feed_parallel(batch)
+    assert whole["x"].shape == (6, 3)
+    with pytest.raises(ValueError):
+        list(feeder.feed_parallel(batch, num_places=4))
+    with pytest.raises(ValueError, match="num_places"):
+        list(feeder.feed_parallel(batch, num_places=0))
