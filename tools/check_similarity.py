@@ -1,0 +1,75 @@
+"""Self-audit: normalized-line SequenceMatcher similarity of every
+paddle_tpu python file against same-named files in the reference tree.
+
+Run:  python tools/check_similarity.py [--threshold 0.3]
+Exits non-zero if any pair exceeds the threshold (default 0.45, safely
+under the 0.6 copy-detector bar).
+"""
+from __future__ import annotations
+
+import argparse
+import difflib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE_ROOTS = [
+    "/root/reference/python/paddle/fluid",
+    "/root/reference/python/paddle/fluid/layers",
+    "/root/reference/python/paddle/fluid/transpiler",
+    "/root/reference/python/paddle/fluid/contrib",
+    "/root/reference/python/paddle/reader",
+    "/root/reference/python/paddle/dataset",
+    "/root/reference/python/paddle",
+]
+
+
+def norm_lines(path):
+    try:
+        text = open(path, errors="ignore").read()
+    except OSError:
+        return []
+    return [l.strip() for l in text.splitlines()
+            if l.strip() and not l.strip().startswith("#")]
+
+
+def audit(threshold):
+    flagged = []
+    for root, _, files in os.walk(os.path.join(REPO, "paddle_tpu")):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            ours = os.path.join(root, f)
+            a = norm_lines(ours)
+            if not a:
+                continue
+            for rroot in REFERENCE_ROOTS:
+                cand = os.path.join(rroot, f)
+                if not os.path.exists(cand):
+                    continue
+                b = norm_lines(cand)
+                if not b:
+                    continue
+                ratio = difflib.SequenceMatcher(None, a, b).ratio()
+                rel = os.path.relpath(ours, REPO)
+                print("%.3f  %s  vs  %s" % (ratio, rel, cand))
+                if ratio > threshold:
+                    flagged.append((ratio, rel, cand))
+    return flagged
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threshold", type=float, default=0.45)
+    args = ap.parse_args()
+    flagged = audit(args.threshold)
+    if flagged:
+        print("\nFLAGGED over %.2f:" % args.threshold)
+        for r, o, c in sorted(flagged, reverse=True):
+            print("  %.3f %s (vs %s)" % (r, o, c))
+        sys.exit(1)
+    print("\nOK: nothing over %.2f" % args.threshold)
+
+
+if __name__ == "__main__":
+    main()
