@@ -39,6 +39,51 @@ def test_single_process_lifecycle():
     assert "LIFECYCLE-OK" in r.stdout
 
 
+def test_two_process_psum_over_localhost():
+    """A real 2-process jax.distributed session: each worker brings 2 cpu
+    devices, the global mesh spans 4, and a cross-process psum agrees
+    (SURVEY §2.4 multi-host readiness, closed end-to-end)."""
+    worker = (
+        "import sys, functools\n"
+        "import numpy as np\n"
+        "from paddle_tpu.parallel import collective as C\n"
+        "C.init_distributed('localhost:12399', 2, int(sys.argv[1]))\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "from jax import shard_map\n"
+        "assert jax.process_count() == 2\n"
+        "devs = jax.devices()\n"
+        "mesh = Mesh(np.array(devs), ('dp',))\n"
+        "@jax.jit\n"
+        "@functools.partial(shard_map, mesh=mesh, in_specs=P('dp'), out_specs=P(), check_vma=False)\n"
+        "def total(x):\n"
+        "    return jax.lax.psum(x.sum(), 'dp')\n"
+        "n = len(devs)\n"
+        "out = total(jnp.arange(n * 2, dtype=jnp.float32))\n"
+        "assert float(np.asarray(out)) == float(sum(range(n * 2)))\n"
+        "C.shutdown_distributed()\n"
+        "print('WORKER-OK')\n"
+    )
+    base_flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+           "XLA_FLAGS": (base_flags + " --xla_force_host_platform_device_count=2").strip()}
+    procs = [subprocess.Popen([sys.executable, "-c", worker, str(i)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              text=True, env=env)
+             for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:  # a timed-out peer must not keep the port bound
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+        assert "WORKER-OK" in out
+
+
 def test_env_defaults(monkeypatch):
     monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
     monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
