@@ -1,26 +1,98 @@
 """IMDB sentiment (reference: python/paddle/dataset/imdb.py — aclImdb
 reviews tokenized against a frequency-sorted word dict).
 
-Synthetic: a Zipfian vocabulary; positive/negative docs are drawn from two
-shifted unigram distributions so sentiment models genuinely separate them.
-Sample schema matches the reference: ([int64 word ids], label 0/1).
+If the real corpus is present at ``DATA_HOME/imdb/aclImdb_v1.tar.gz``
+(user-supplied — this environment cannot download), it is parsed like the
+reference: one streaming pass over the tarball, lowercased
+punctuation-stripped tokens, a frequency dict with cutoff 150, samples
+``([int64 word ids], label)`` with pos=0 / neg=1 per split directory.
+Otherwise: synthetic docs from two shifted Zipf unigram distributions so
+sentiment models genuinely separate the classes.
 """
 from __future__ import annotations
 
+import os
+import re
+import string
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
-__all__ = ["word_dict", "train", "test"]
+__all__ = ["word_dict", "build_dict", "train", "test"]
 
 VOCAB = 5147  # same size the reference builds from aclImdb with cutoff 150
 TRAIN_SIZE = 1024
 TEST_SIZE = 256
+_CUTOFF = 150
+
+_real_cache: dict | None = None
+
+
+def _tar_path():
+    p = os.path.join(DATA_HOME, "imdb", "aclImdb_v1.tar.gz")
+    return p if os.path.exists(p) else None
+
+
+_TRANS = str.maketrans("", "", string.punctuation)
+
+
+def _tokens(raw: bytes):
+    return raw.decode("latin-1").lower().translate(_TRANS).split()
+
+
+def _load_real():
+    """One streaming pass: {'train/pos': [tokens...], ...} + the freq dict."""
+    global _real_cache
+    if _real_cache is not None:
+        return _real_cache
+    path = _tar_path()
+    if path is None:
+        return None
+    pats = {
+        "train/pos": re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        "train/neg": re.compile(r"aclImdb/train/neg/.*\.txt$"),
+        "test/pos": re.compile(r"aclImdb/test/pos/.*\.txt$"),
+        "test/neg": re.compile(r"aclImdb/test/neg/.*\.txt$"),
+    }
+    docs: dict[str, list] = {k: [] for k in pats}
+    freq: dict[str, int] = {}
+    with tarfile.open(path) as tf:
+        member = tf.next()  # sequential scan: random access over a .gz is slow
+        while member is not None:
+            for key, pat in pats.items():
+                if pat.match(member.name):
+                    toks = _tokens(tf.extractfile(member).read())
+                    docs[key].append(toks)
+                    if key.startswith("train/"):
+                        for t in toks:
+                            freq[t] = freq.get(t, 0) + 1
+                    break
+            member = tf.next()
+    _real_cache = {"docs": docs, "freq": freq, "dicts": {}}
+    return _real_cache
+
+
+def build_dict(pattern=None, cutoff=_CUTOFF):
+    """Frequency-ranked word -> id dict at the given cutoff (honored in
+    real mode, cached per cutoff)."""
+    real = _load_real()
+    if real is None:
+        return {"w%d" % i: i for i in range(VOCAB)}
+    if cutoff not in real["dicts"]:
+        freq = real["freq"]
+        kept = [w for w, c in freq.items() if c >= cutoff]
+        kept.sort(key=lambda w: (-freq[w], w))  # frequency-ranked ids
+        word_idx = {w: i for i, w in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        real["dicts"][cutoff] = word_idx
+    return real["dicts"][cutoff]
 
 
 def word_dict():
     """word -> id, frequency-ranked like the reference build_dict."""
-    return {"w%d" % i: i for i in range(VOCAB)}
+    return build_dict()
 
 
 def _doc(r, vocab, label, length):
@@ -31,21 +103,35 @@ def _doc(r, vocab, label, length):
     return list(np.clip(ids, 0, vocab - 1).astype("int64"))
 
 
-def _reader_creator(split, size):
+def _reader_creator(split, size, word_idx=None):
+    encoded = {}  # id(dict) -> samples: encode ONCE, not once per epoch
+
     def reader():
+        real = _load_real()
+        if real is not None:
+            wi = word_idx or build_dict()
+            key = id(wi)
+            if key not in encoded:
+                unk = wi.get("<unk>", len(wi) - 1)
+                encoded[key] = [
+                    ([wi.get(t, unk) for t in toks], label)
+                    for label, dkey in ((0, split + "/pos"), (1, split + "/neg"))
+                    for toks in real["docs"][dkey]
+                ]
+            yield from encoded[key]
+            return
         r = rng_for("imdb", split)
-        vocab = VOCAB
         for _ in range(size):
             label = int(r.randint(0, 2))
             length = int(r.randint(8, 64))
-            yield _doc(r, vocab, label, length), label
+            yield _doc(r, VOCAB, label, length), label
 
     return reader
 
 
 def train(word_idx=None):
-    return _reader_creator("train", TRAIN_SIZE)
+    return _reader_creator("train", TRAIN_SIZE, word_idx)
 
 
 def test(word_idx=None):
-    return _reader_creator("test", TEST_SIZE)
+    return _reader_creator("test", TEST_SIZE, word_idx)

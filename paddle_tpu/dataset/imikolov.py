@@ -1,14 +1,21 @@
 """imikolov / PTB n-gram LM data (reference: python/paddle/dataset/imikolov.py).
 
-Synthetic: a Markov-ish token stream over a Zipf vocabulary; ``train(word_idx,
-n)`` yields n-tuples of int64 ids exactly like the reference NGRAM mode, and
-``data_type=SEQ`` yields whole sequences.
+If the real corpus is present at ``DATA_HOME/imikolov/simple-examples.tgz``
+(user-supplied — no network here), it is parsed like the reference:
+``ptb.train.txt`` / ``ptb.valid.txt`` members, a frequency dict
+(min_word_freq cutoff, '<unk>' appended last), sentences wrapped in
+``<s> ... <e>`` for NGRAM mode.  Otherwise: a synthetic Zipf token stream
+with the same sample schema — ``train(word_idx, n)`` yields n-tuples of
+int64 ids, ``data_type=SEQ`` yields whole sequences.
 """
 from __future__ import annotations
 
+import os
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["build_dict", "train", "test", "DataType"]
 
@@ -16,17 +23,64 @@ VOCAB = 2073
 TRAIN_SENTENCES = 512
 TEST_SENTENCES = 128
 
+_MEMBERS = {
+    "train": "./simple-examples/data/ptb.train.txt",
+    "test": "./simple-examples/data/ptb.valid.txt",
+}
+
+_real_cache: dict = {}
+
 
 class DataType:
     NGRAM = 1
     SEQ = 2
 
 
+def _tgz_path():
+    p = os.path.join(DATA_HOME, "imikolov", "simple-examples.tgz")
+    return p if os.path.exists(p) else None
+
+
+def _real_lines(split):
+    path = _tgz_path()
+    if path is None:
+        return None
+    if split not in _real_cache:
+        with tarfile.open(path) as tf:
+            raw = tf.extractfile(_MEMBERS[split]).read().decode("utf-8")
+        _real_cache[split] = [l.strip().split() for l in raw.splitlines() if l.strip()]
+    return _real_cache[split]
+
+
 def build_dict(min_word_freq=50):
-    return {"w%d" % i: i for i in range(VOCAB)}
+    """Reference semantics: frequencies counted over train AND valid,
+    kept when STRICTLY above min_word_freq, '<unk>' appended last."""
+    train_lines = _real_lines("train")
+    if train_lines is None:
+        return {"w%d" % i: i for i in range(VOCAB)}
+    if ("dict", min_word_freq) not in _real_cache:
+        freq: dict[str, int] = {}
+        for words in list(train_lines) + list(_real_lines("test") or []):
+            for w in words:
+                freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        kept = [w for w, c in freq.items() if c > min_word_freq]
+        kept.sort(key=lambda w: (-freq[w], w))
+        word_idx = {w: i for i, w in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        _real_cache[("dict", min_word_freq)] = word_idx
+    return _real_cache[("dict", min_word_freq)]
 
 
-def _sentences(split, count):
+def _sentences(split, count, word_idx):
+    lines = _real_lines(split)
+    if lines is not None:
+        word_idx = word_idx or build_dict()
+        unk = word_idx["<unk>"]
+        s, e = word_idx.get("<s>", unk), word_idx.get("<e>", unk)
+        for words in lines:
+            yield [s] + [word_idx.get(w, unk) for w in words] + [e]
+        return
     r = rng_for("imikolov", split)
     for _ in range(count):
         length = int(r.randint(5, 20))
@@ -36,12 +90,13 @@ def _sentences(split, count):
 
 def _reader_creator(split, count, word_idx, n, data_type):
     def reader():
-        for sent in _sentences(split, count):
+        for sent in _sentences(split, count, word_idx):
             if data_type == DataType.NGRAM:
-                if len(sent) >= n:
-                    sent_a = [0] * (n - 1) + sent  # pad with <s>=0 like the reference
-                    for i in range(n - 1, len(sent_a)):
-                        yield tuple(sent_a[i - n + 1 : i + 1])
+                # reference semantics: no padding — only sentences with at
+                # least n tokens yield grams (real sentences already carry
+                # <s>/<e> from _sentences)
+                for i in range(n - 1, len(sent)):
+                    yield tuple(sent[i - n + 1 : i + 1])
             else:
                 yield (sent,)
 

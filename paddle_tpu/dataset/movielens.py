@@ -1,14 +1,23 @@
 """MovieLens-1M (reference: python/paddle/dataset/movielens.py).
 
-Synthetic users/movies with the reference's feature schema:
+If the real archive is present at ``DATA_HOME/movielens/ml-1m.zip``
+(user-supplied — no network here), it is parsed like the reference:
+``movies.dat`` / ``users.dat`` / ``ratings.dat`` with '::' separators and
+latin-1 encoding, categories and title words indexed into dicts built
+from the data, ratings split 90/10 train/test by a deterministic hash.
+Otherwise: synthetic users/movies with the same feature schema —
 (user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
-rating) — all int64 lists/scalars + float rating in [1, 5].
+rating), all int64 lists/scalars + float rating in [1, 5].
 """
 from __future__ import annotations
 
+import os
+import re
+import zipfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = [
     "train", "test", "get_movie_title_dict", "max_movie_id", "max_user_id",
@@ -29,28 +38,94 @@ age_table = [1, 18, 25, 35, 45, 50, 56]
 TRAIN_SIZE = 2048
 TEST_SIZE = 256
 
+_real_cache: dict | None = None
+
+
+def _zip_path():
+    p = os.path.join(DATA_HOME, "movielens", "ml-1m.zip")
+    return p if os.path.exists(p) else None
+
+
+def _load_real():
+    """Parse ml-1m once: users/movies feature dicts + per-split ratings."""
+    global _real_cache
+    if _real_cache is not None:
+        return _real_cache
+    path = _zip_path()
+    if path is None:
+        return None
+
+    def lines(zf, name):
+        return zf.read("ml-1m/" + name).decode("latin-1").splitlines()
+
+    with zipfile.ZipFile(path) as zf:
+        cat_idx: dict[str, int] = {}
+        title_idx: dict[str, int] = {}
+        movies = {}
+        title_pat = re.compile(r"(.*)\((\d{4})\)$")
+        for line in lines(zf, "movies.dat"):
+            mid, title, cats = line.strip().split("::")
+            m = title_pat.match(title)
+            words = (m.group(1) if m else title).strip().lower().split()
+            for c in cats.split("|"):
+                cat_idx.setdefault(c, len(cat_idx))
+            for w in words:
+                title_idx.setdefault(w, len(title_idx))
+            movies[int(mid)] = (
+                sorted(cat_idx[c] for c in cats.split("|")),
+                [title_idx[w] for w in words],
+            )
+        users = {}
+        for line in lines(zf, "users.dat"):
+            uid, gender, age, job = line.strip().split("::")[:4]
+            users[int(uid)] = (
+                0 if gender == "M" else 1,
+                age_table.index(int(age)) if int(age) in age_table else 0,
+                int(job),
+            )
+        ratings = {"train": [], "test": []}
+        for line in lines(zf, "ratings.dat"):
+            uid, mid, rating = line.strip().split("::")[:3]
+            split = "test" if (int(uid) * 2654435761 + int(mid)) % 10 == 0 else "train"
+            ratings[split].append((int(uid), int(mid), float(rating)))
+    _real_cache = {
+        "users": users, "movies": movies, "ratings": ratings,
+        "cat_idx": cat_idx, "title_idx": title_idx,
+    }
+    return _real_cache
+
 
 def max_user_id():
-    return NUM_USERS
+    real = _load_real()
+    return max(real["users"]) if real else NUM_USERS
 
 
 def max_movie_id():
-    return NUM_MOVIES
+    real = _load_real()
+    return max(real["movies"]) if real else NUM_MOVIES
 
 
 def max_job_id():
+    real = _load_real()
+    if real:
+        return max(j for _, _, j in real["users"].values())
     return NUM_JOBS - 1
 
 
 def movie_categories():
-    return {c: i for i, c in enumerate(CATEGORIES)}
+    real = _load_real()
+    return dict(real["cat_idx"]) if real else {c: i for i, c in enumerate(CATEGORIES)}
 
 
 def get_movie_title_dict():
-    return {"t%d" % i: i for i in range(TITLE_VOCAB)}
+    real = _load_real()
+    return dict(real["title_idx"]) if real else {"t%d" % i: i for i in range(TITLE_VOCAB)}
 
 
 def _movies():
+    real = _load_real()
+    if real:
+        return dict(real["movies"])
     r = rng_for("movielens", "movies")
     movies = {}
     for mid in range(1, NUM_MOVIES + 1):
@@ -62,6 +137,9 @@ def _movies():
 
 
 def _users():
+    real = _load_real()
+    if real:
+        return dict(real["users"])
     r = rng_for("movielens", "users")
     users = {}
     for uid in range(1, NUM_USERS + 1):
@@ -71,6 +149,14 @@ def _users():
 
 def _reader_creator(split, size):
     def reader():
+        real = _load_real()
+        if real:
+            users, movies = real["users"], real["movies"]
+            for uid, mid, rating in real["ratings"][split]:
+                gender, age, job = users[uid]
+                cats, title = movies[mid]
+                yield [uid], [gender], [age], [job], [mid], cats, title, [rating]
+            return
         users, movies = _users(), _movies()
         r = rng_for("movielens", split)
         for _ in range(size):
