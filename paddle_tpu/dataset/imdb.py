@@ -43,7 +43,12 @@ def _tokens(raw: bytes):
 
 
 def _load_real():
-    """One streaming pass: {'train/pos': [tokens...], ...} + the freq dict."""
+    """One streaming pass: {'train/pos': [tokens...], ...} + the freq dict.
+
+    The tokenized corpus stays cached for the process (the reference
+    re-streams the tarball every epoch instead — lighter on memory, far
+    slower per epoch; readers here additionally cache their encoded int
+    ids so epochs after the first do no string work at all)."""
     global _real_cache
     if _real_cache is not None:
         return _real_cache
@@ -65,9 +70,9 @@ def _load_real():
                 if pat.match(member.name):
                     toks = _tokens(tf.extractfile(member).read())
                     docs[key].append(toks)
-                    if key.startswith("train/"):
-                        for t in toks:
-                            freq[t] = freq.get(t, 0) + 1
+                    # reference counts over train AND test splits
+                    for t in toks:
+                        freq[t] = freq.get(t, 0) + 1
                     break
             member = tf.next()
     _real_cache = {"docs": docs, "freq": freq, "dicts": {}}
@@ -82,7 +87,7 @@ def build_dict(pattern=None, cutoff=_CUTOFF):
         return {"w%d" % i: i for i in range(VOCAB)}
     if cutoff not in real["dicts"]:
         freq = real["freq"]
-        kept = [w for w, c in freq.items() if c >= cutoff]
+        kept = [w for w, c in freq.items() if c > cutoff]  # strict, as the reference
         kept.sort(key=lambda w: (-freq[w], w))  # frequency-ranked ids
         word_idx = {w: i for i, w in enumerate(kept)}
         word_idx["<unk>"] = len(word_idx)
@@ -104,21 +109,23 @@ def _doc(r, vocab, label, length):
 
 
 def _reader_creator(split, size, word_idx=None):
-    encoded = {}  # id(dict) -> samples: encode ONCE, not once per epoch
+    # the dict is fixed per creator (the argument or the default dict), so
+    # one nonlocal cache suffices: encode ONCE, not once per epoch
+    encoded = None
 
     def reader():
+        nonlocal encoded
         real = _load_real()
         if real is not None:
-            wi = word_idx or build_dict()
-            key = id(wi)
-            if key not in encoded:
+            if encoded is None:
+                wi = word_idx or build_dict()
                 unk = wi.get("<unk>", len(wi) - 1)
-                encoded[key] = [
+                encoded = [
                     ([wi.get(t, unk) for t in toks], label)
                     for label, dkey in ((0, split + "/pos"), (1, split + "/neg"))
                     for toks in real["docs"][dkey]
                 ]
-            yield from encoded[key]
+            yield from encoded
             return
         r = rng_for("imdb", split)
         for _ in range(size):

@@ -1,32 +1,92 @@
 """WMT14 en-fr (reference: python/paddle/dataset/wmt14.py).
 
-Synthetic parallel corpus: target = deterministic per-token mapping of
-source (+ length jitter), so seq2seq models can genuinely learn the
-"translation".  Sample schema matches the reference:
-(src_ids, trg_ids, trg_next_ids) with <s>=0, <e>=1, <unk>=2.
+If the real preprocessed archive is present at
+``DATA_HOME/wmt14/wmt14.tgz`` (user-supplied — no network here), it is
+parsed like the reference: ``*src.dict`` / ``*trg.dict`` members give the
+first ``dict_size`` words their line-number ids, corpus members ending in
+``train``/``test`` hold tab-separated parallel sentences, and samples are
+``(src_ids, trg_in_ids, trg_next_ids)`` with ``<s>``-wrapped source and
+shifted target.  Otherwise: a synthetic parallel corpus whose target is a
+deterministic per-token mapping of the source (+ length jitter), so
+seq2seq models genuinely learn the "translation".  Ids: <s>=0, <e>=1,
+<unk>=2 in both modes.
 """
 from __future__ import annotations
 
+import os
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test", "get_dict"]
 
 TRAIN_SIZE = 512
 TEST_SIZE = 128
+START, END, UNK = "<s>", "<e>", "<unk>"
+UNK_IDX = 2
+
+_dict_cache: dict = {}
+
+
+def _tgz_path():
+    p = os.path.join(DATA_HOME, "wmt14", "wmt14.tgz")
+    return p if os.path.exists(p) else None
+
+
+def _real_dicts(dict_size):
+    key = ("dicts", dict_size)
+    if key not in _dict_cache:
+        path = _tgz_path()
+        with tarfile.open(path) as tf:
+            out = []
+            for suffix in ("src.dict", "trg.dict"):
+                names = [m.name for m in tf if m.name.endswith(suffix)]
+                assert len(names) == 1, (suffix, names)
+                lines = tf.extractfile(names[0]).read().decode("utf-8").splitlines()
+                out.append({w.strip(): i for i, w in enumerate(lines[:dict_size])})
+        _dict_cache[key] = tuple(out)
+    return _dict_cache[key]
 
 
 def get_dict(dict_size, reverse=False):
-    src = {"w%d" % i: i for i in range(dict_size)}
-    trg = {"t%d" % i: i for i in range(dict_size)}
+    if _tgz_path() is not None:
+        src, trg = _real_dicts(dict_size)
+    else:
+        src = {"w%d" % i: i for i in range(dict_size)}
+        trg = {"t%d" % i: i for i in range(dict_size)}
     if reverse:
         src = {v: k for k, v in src.items()}
         trg = {v: k for k, v in trg.items()}
     return src, trg
 
 
+def _real_reader(split, dict_size):
+    def reader():
+        src_dict, trg_dict = _real_dicts(dict_size)
+        start_id, end_id = trg_dict.get(START, 0), trg_dict.get(END, 1)
+        with tarfile.open(_tgz_path()) as tf:
+            names = [m.name for m in tf if m.name.endswith(split) and m.isfile()]
+            for name in names:
+                for raw in tf.extractfile(name).read().decode("utf-8").splitlines():
+                    parts = raw.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [src_dict.get(w, UNK_IDX)
+                               for w in [START] + parts[0].split() + [END]]
+                    trg_ids = [trg_dict.get(w, UNK_IDX) for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue  # reference drops over-length pairs
+                    yield src_ids, [start_id] + trg_ids, trg_ids + [end_id]
+
+    return reader
+
+
 def _reader(split, size, dict_size):
+    if _tgz_path() is not None:
+        return _real_reader(split, dict_size)
+
     def reader():
         r = rng_for("wmt14", split)
         for _ in range(size):

@@ -27,7 +27,12 @@ def lstm_net(sentence, lstm_size, depth=1):
     return logit
 
 
-def get_model(batch_size=64, lstm_size=LSTM_SIZE, emb_dim=EMB_DIM, vocab_size=VOCAB_SIZE, depth=1, lr=0.001):
+def get_model(batch_size=64, lstm_size=LSTM_SIZE, emb_dim=EMB_DIM, vocab_size=None, depth=1, lr=0.001):
+    if vocab_size is None:
+        # real aclImdb corpus (when present) has its own dict size
+        from ..dataset import imdb
+
+        vocab_size = len(imdb.word_dict())
     import paddle_tpu as fluid
 
     main = fluid.Program()

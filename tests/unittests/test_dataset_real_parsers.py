@@ -52,9 +52,12 @@ def test_imdb_parses_aclimdb_tarball(fake_home):
     assert pos_ids[0] == pos_ids[1] == word_idx["great"]  # punctuation stripped
     test = list(imdb.test(word_idx)())
     assert [lbl for _, lbl in test] == [0, 1]
-    # the default cutoff-150 dict is a different (tiny) dict — the passed
-    # word_idx must be the one actually used for encoding
-    assert len(imdb.word_dict()) != len(word_idx) or imdb.word_dict() is not word_idx
+    # the passed word_idx must be the one actually used for encoding: with
+    # the default (cutoff-150) dict this tiny corpus maps everything to
+    # <unk>, so ids matching word_idx["great"] prove the argument was used
+    default_train = list(imdb.train()())
+    default_unk = imdb.word_dict().get("<unk>")
+    assert all(i == default_unk for ids, _ in default_train for i in ids)
 
 
 def test_imikolov_parses_ptb_tgz(fake_home):
@@ -100,6 +103,39 @@ def test_movielens_parses_ml1m_zip(fake_home):
     # user 1 is female -> gender id 1; user 2 age 56 -> last age bucket
     u = movielens.user_info()
     assert u[1][0] == 1 and u[2][1] == len(movielens.age_table) - 1
+
+
+def test_wmt14_parses_preprocessed_tgz(fake_home, monkeypatch):
+    from paddle_tpu.dataset import wmt14
+
+    monkeypatch.setattr(wmt14, "DATA_HOME", fake_home)
+    wmt14._dict_cache = {}
+    d = os.path.join(fake_home, "wmt14")
+    os.makedirs(d)
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    corpus = "hello world\tbonjour monde\nhello\tbonjour\nbroken line\n"
+    with tarfile.open(os.path.join(d, "wmt14.tgz"), "w:gz") as tf:
+        _add_text(tf, "wmt14/src.dict", src_dict)
+        _add_text(tf, "wmt14/trg.dict", trg_dict)
+        _add_text(tf, "wmt14/train/part-00.train", corpus)
+        _add_text(tf, "wmt14/test/part-00.test", "world\tmonde\n")
+    try:
+        sd, td = wmt14.get_dict(5)
+        assert sd["hello"] == 3 and td["monde"] == 4
+        rows = list(wmt14.train(5)())
+        assert len(rows) == 2  # the tab-less line is skipped
+        src_ids, trg_in, trg_next = rows[0]
+        assert src_ids == [0, 3, 4, 1]       # <s> hello world <e>
+        assert trg_in == [0, 3, 4]           # <s> bonjour monde
+        assert trg_next == [3, 4, 1]         # bonjour monde <e>
+        (t_src, _, _), = wmt14.test(5)()
+        assert t_src == [0, 4, 1]
+        # dict_size truncation: ids past the cap become <unk>
+        sd3, _ = wmt14.get_dict(4)
+        assert "world" not in sd3
+    finally:
+        wmt14._dict_cache = {}
 
 
 def test_synthetic_fallback_without_archives(fake_home):
