@@ -522,8 +522,12 @@ class Executor:
 
         self.place = place if place is not None else TPUPlace()
         self._cache: dict = {}
-        # set by ParallelExecutor: jax.sharding.Mesh for data-parallel SPMD
+        # set by ParallelExecutor: jax.sharding.Mesh for data-parallel SPMD;
+        # a 2-D ("dp","tp") mesh additionally Megatron-shards parameters
+        # (see parallel/tp.py), optionally refined by _sharding_rules
+        # ([(regex, PartitionSpec)]).
         self._mesh = None
+        self._sharding_rules = None
 
     # -- public API ----------------------------------------------------------
     def run(
@@ -736,7 +740,7 @@ class Executor:
             env = {}
             env.update(state)
             env.update(feeds)
-            ctx = LoweringContext(program, env, use_key)
+            ctx = LoweringContext(program, env, use_key, mesh=self._mesh)
             lower_block(ctx, program.global_block())
             fetches = []
             for f in fetch_names:
@@ -759,15 +763,19 @@ class Executor:
 
             return runner
 
-        # data-parallel SPMD: feeds batch-sharded on 'dp', state replicated;
-        # XLA's partitioner inserts the gradient psum over ICI automatically
-        # (the reference built NCCL all-reduce ops by hand:
-        # framework/details/multi_devices_graph_builder.cc).
+        # SPMD: feeds batch-sharded on 'dp'; state replicated on a 1-D mesh,
+        # or Megatron tp-sharded (parallel/tp.py) when the mesh carries a
+        # 'tp' axis.  XLA's partitioner inserts the gradient psum / tp
+        # collectives over ICI automatically (the reference built NCCL
+        # all-reduce ops by hand: framework/details/multi_devices_graph_builder.cc).
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        ndev = int(np.prod(mesh.devices.shape))
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        dp_size = int(axis_sizes.get("dp", int(np.prod(mesh.devices.shape))))
+        tp_size = int(axis_sizes.get("tp", 1))
         repl = NamedSharding(mesh, P())
         cell = {}
+        rules = self._sharding_rules
 
         # only declared data vars batch-shard on dp: a coincidentally
         # batch-divisible non-data feed (e.g. a [ndev*k, d] constant table)
@@ -779,17 +787,34 @@ class Executor:
             if jitted is None:
                 feed_shardings = {
                     n: NamedSharding(mesh, P("dp"))
-                    if n in data_names and np.ndim(v) >= 1 and np.shape(v)[0] % ndev == 0
+                    if n in data_names and np.ndim(v) >= 1 and np.shape(v)[0] % dp_size == 0
                     else repl
                     for n, v in feeds.items()
                 }
-                state_shardings = {n: repl for n in state}
+                if tp_size > 1:
+                    from .parallel.tp import make_param_shardings
+
+                    state_shardings = make_param_shardings(state, mesh, rules=rules)
+                else:
+                    state_shardings = {n: repl for n in state}
                 jitted = jax.jit(
                     step,
                     in_shardings=(state_shardings, feed_shardings, repl),
                     donate_argnums=(0,),
                 )
                 cell["jit"] = jitted
+                cell["state_shardings"] = state_shardings
+            # XLA's partitioner may hand state OUT in different shardings
+            # than the declared in_shardings (e.g. a bias left tp-sharded
+            # after propagation); jit refuses committed args that disagree,
+            # so reshard drifted entries explicitly (no-op when they match).
+            state_shardings = cell["state_shardings"]
+            state = {
+                n: v
+                if getattr(v, "sharding", None) == state_shardings.get(n)
+                else jax.device_put(v, state_shardings[n])
+                for n, v in state.items()
+            }
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
                 return jitted(state, feeds, key)

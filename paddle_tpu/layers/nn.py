@@ -1635,11 +1635,17 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None, name=No
     return out
 
 
-def flash_attention(q, k, v, kv_lens=None, causal=False, name=None):
+def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=False, name=None):
     """Fused flash attention over [batch, heads, time, head_dim] tensors
     (pallas TPU kernel; see parallel/flash_attention.py).  ``kv_lens``
     ([batch] int) applies a key padding mask without building a [T, S]
-    bias.  No reference analog — the reference composes matmul+softmax."""
+    bias.  No reference analog — the reference composes matmul+softmax.
+
+    ``sequence_parallel=True`` opts this op into ring attention over the
+    executor mesh's ``sp`` axis (parallel/ring_attention.py) when the
+    program runs under a ``ParallelExecutor`` whose ``mesh_shape`` carries
+    one — the time dimension is block-sharded across devices and K/V blocks
+    rotate over ICI.  Without an sp axis the attr is a no-op."""
     helper = LayerHelper("flash_attention", **locals())
     out = helper.create_variable_for_type_inference(dtype=q.dtype, shape=q.shape)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -1649,6 +1655,6 @@ def flash_attention(q, k, v, kv_lens=None, causal=False, name=None):
         type="flash_attention",
         inputs=inputs,
         outputs={"Out": [out]},
-        attrs={"causal": causal},
+        attrs={"causal": causal, "sequence_parallel": bool(sequence_parallel)},
     )
     return out

@@ -23,5 +23,22 @@ def _flash_attention(ctx, op):
     if kv_lens is not None:
         kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
     causal = bool(op.attrs.get("causal", False))
+
+    # sequence-parallel ring attention over the executor mesh's 'sp' axis:
+    # shard_map blocks T across devices and rotates K/V over ICI (ppermute).
+    # Falls back to the single-shard kernel when there's no sp axis, the
+    # axis is trivial, T doesn't divide, or kv_lens masking is requested
+    # (the ring path assumes dense blocks).
+    if bool(op.attrs.get("sequence_parallel", False)) and ctx.mesh is not None:
+        mesh = ctx.mesh
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        sp = int(axis_sizes.get("sp", 1))
+        if sp > 1 and kv_lens is None and q.shape[2] % sp == 0:
+            from ..parallel.ring_attention import ring_attention_sharded
+
+            out = ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=causal)
+            ctx.set_output(op, "Out", out)
+            return
+
     out = flash_attention(q, k, v, kv_lens, causal)
     ctx.set_output(op, "Out", out)

@@ -9,6 +9,14 @@ batch-sharded ``NamedSharding``s, parameters are replicated, and XLA's SPMD
 partitioner inserts the gradient all-reduce (psum over ICI) automatically.
 So "build strategy" reduces to sharding annotations — the collectives ride
 ICI with no user-visible communication code.
+
+Model/tensor parallelism is first-class: pass ``mesh_shape=(dp, tp)`` (or a
+``{"dp": .., "tp": .., "sp": ..}`` dict, or set ``BuildStrategy.mesh_shape``)
+and parameters are Megatron-sharded over the ``tp`` axis via
+``parallel.tp.make_param_shardings`` — column/row splits chosen by shape
+heuristic, overridable per-parameter with ``sharding_rules``
+([(name_regex, PartitionSpec)]).  An ``sp`` axis enables sequence-parallel
+ring attention inside ``layers.flash_attention(sequence_parallel=True)``.
 """
 from __future__ import annotations
 
@@ -46,6 +54,12 @@ class BuildStrategy:
         self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         self.debug_graphviz_path = ""
         self.enable_data_balance = False
+        # TPU extensions (no reference analog — the reference is dp-only):
+        # mesh_shape: (dp, tp[, sp]) tuple or {"dp": .., "tp": .., "sp": ..};
+        # sharding_rules: [(param_name_regex, PartitionSpec)] overrides for
+        # parallel.tp.make_param_shardings.
+        self.mesh_shape = None
+        self.sharding_rules = None
 
 
 class ParallelExecutor:
@@ -62,6 +76,8 @@ class ParallelExecutor:
         scope=None,
         use_tpu=True,
         devices=None,
+        mesh_shape=None,
+        sharding_rules=None,
     ):
         import jax
         from jax.sharding import Mesh
@@ -69,10 +85,29 @@ class ParallelExecutor:
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
         self._scope = scope or global_scope()
-        devs = devices if devices is not None else jax.devices()
-        self._mesh = Mesh(np.array(devs), ("dp",))
+        devs = list(devices if devices is not None else jax.devices())
+        if mesh_shape is None and build_strategy is not None:
+            mesh_shape = getattr(build_strategy, "mesh_shape", None)
+        if sharding_rules is None and build_strategy is not None:
+            sharding_rules = getattr(build_strategy, "sharding_rules", None)
+        if mesh_shape:
+            if isinstance(mesh_shape, dict):
+                names = tuple(mesh_shape)
+                sizes = tuple(int(mesh_shape[n]) for n in names)
+            else:
+                sizes = tuple(int(s) for s in mesh_shape)
+                names = ("dp", "tp", "sp")[: len(sizes)]
+            need = int(np.prod(sizes))
+            if need > len(devs):
+                raise ValueError(
+                    "mesh_shape %r needs %d devices, only %d available"
+                    % (mesh_shape, need, len(devs)))
+            self._mesh = Mesh(np.array(devs[:need]).reshape(sizes), names)
+        else:
+            self._mesh = Mesh(np.array(devs), ("dp",))
         self._exe = Executor()
         self._exe._mesh = self._mesh
+        self._exe._sharding_rules = sharding_rules
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
