@@ -18,7 +18,17 @@
 //       desynchronizing the protocol.
 //   op 2 (PULL):  n:u32 | (row_id:u32)*n         -> status:u8 | f32*width*n
 //   op 3 (SAVE):  path_len:u16 | path            -> status:u8
+//       Versioned snapshot: magic "PSV2" | opt:u32 | eps,beta1,beta2:f32 |
+//       rows:u32 | width:u32 | data | opt-state arrays | adam step counts.
 //   op 4 (SHUTDOWN)                              -> status:u8
+//   op 5 (CONFIG): opt:u8 (0 SGD, 1 Adagrad, 2 Adam) | eps:f32 | beta1:f32
+//       | beta2:f32 -> status:u8   (reference go/pserver/optimizer.go: the
+//       update rule is server-side and per-table configurable; lr still
+//       rides each PUSH).  Optimizer state is allocated lazily.
+//   op 6 (LOAD):  path_len:u16 | path            -> status:u8
+//       Restores a SAVE snapshot — table payload AND optimizer state — so
+//       a restarted pserver resumes without losing learned rows.  Also
+//       reads legacy V1 snapshots (rows|width|data only).
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -26,6 +36,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -37,13 +48,55 @@
 
 namespace {
 
+enum Opt : uint32_t { kSGD = 0, kAdagrad = 1, kAdam = 2 };
+
 struct Table {
   uint32_t rows = 0, width = 0;
   std::vector<float> data;
   std::vector<std::mutex> row_locks;
+  // server-side update rule (reference go/pserver/optimizer.go)
+  uint32_t opt = kSGD;
+  float eps = 1e-8f, beta1 = 0.9f, beta2 = 0.999f;
+  std::vector<float> accum;     // Adagrad: sum of squared grads / Adam: m
+  std::vector<float> accum2;    // Adam: v
+  std::vector<uint32_t> steps;  // Adam: per-row step count (bias correction)
 
   Table() = default;
   Table(uint32_t r, uint32_t w) : rows(r), width(w), data(size_t(r) * w, 0.f), row_locks(r) {}
+
+  void ensure_state() {
+    if (opt == kAdagrad && accum.empty()) accum.assign(data.size(), 0.f);
+    if (opt == kAdam) {
+      if (accum.empty()) accum.assign(data.size(), 0.f);
+      if (accum2.empty()) accum2.assign(data.size(), 0.f);
+      if (steps.empty()) steps.assign(rows, 0);
+    }
+  }
+
+  // caller holds row_locks[row]
+  void apply_row(uint32_t row, const float* grad, float lr) {
+    float* w = &data[size_t(row) * width];
+    if (opt == kSGD) {
+      for (uint32_t j = 0; j < width; ++j) w[j] -= lr * grad[j];
+    } else if (opt == kAdagrad) {
+      float* a = &accum[size_t(row) * width];
+      for (uint32_t j = 0; j < width; ++j) {
+        a[j] += grad[j] * grad[j];
+        w[j] -= lr * grad[j] / (std::sqrt(a[j]) + eps);
+      }
+    } else {  // Adam
+      float* m = &accum[size_t(row) * width];
+      float* v = &accum2[size_t(row) * width];
+      uint32_t t = ++steps[row];
+      float bc1 = 1.f - std::pow(beta1, float(t));
+      float bc2 = 1.f - std::pow(beta2, float(t));
+      for (uint32_t j = 0; j < width; ++j) {
+        m[j] = beta1 * m[j] + (1.f - beta1) * grad[j];
+        v[j] = beta2 * v[j] + (1.f - beta2) * grad[j] * grad[j];
+        w[j] -= lr * (m[j] / bc1) / (std::sqrt(v[j] / bc2) + eps);
+      }
+    }
+  }
 };
 
 struct Server {
@@ -102,12 +155,16 @@ struct Server {
         uint32_t width, n;
         if (!read_all(fd, &lr, 4) || !read_all(fd, &width, 4) || !read_all(fd, &n, 4)) break;
         Table* t;
+        bool apply;
         {
           std::lock_guard<std::mutex> lk(tables_mu);
           auto it = tables.find(table);
           t = it == tables.end() ? nullptr : &it->second;
+          apply = t && t->width == width;
+          // lazy optimizer-state allocation is serialized here; per-row
+          // updates below only need the row lock
+          if (apply) t->ensure_state();
         }
-        bool apply = t && t->width == width;
         if (!apply) ok = 0;
         // always consume the full payload (client-declared width) so an
         // unknown table / width mismatch can't desync the connection
@@ -118,8 +175,7 @@ struct Server {
           if (width && !read_all(fd, grad.data(), size_t(width) * 4)) return;
           if (apply && row < t->rows) {
             std::lock_guard<std::mutex> lk(t->row_locks[row]);
-            float* dst = &t->data[size_t(row) * t->width];
-            for (uint32_t j = 0; j < t->width; ++j) dst[j] -= lr * grad[j];
+            t->apply_row(row, grad.data(), lr);
           }
         }
         if (!write_all(fd, &ok, 1)) break;
@@ -147,7 +203,7 @@ struct Server {
           }
           if (!write_all(fd, out.data(), out.size() * 4)) break;
         }
-      } else if (op == 3) {  // SAVE
+      } else if (op == 3) {  // SAVE (versioned: payload + optimizer state)
         uint16_t plen;
         if (!read_all(fd, &plen, 2)) break;
         std::string path(plen, '\0');
@@ -161,11 +217,99 @@ struct Server {
           if (!f) {
             ok = 0;
           } else {
-            fwrite(&it->second.rows, 4, 1, f);
-            fwrite(&it->second.width, 4, 1, f);
-            fwrite(it->second.data.data(), 4, it->second.data.size(), f);
+            Table& t = it->second;
+            fwrite("PSV2", 1, 4, f);
+            fwrite(&t.opt, 4, 1, f);
+            fwrite(&t.eps, 4, 1, f);
+            fwrite(&t.beta1, 4, 1, f);
+            fwrite(&t.beta2, 4, 1, f);
+            fwrite(&t.rows, 4, 1, f);
+            fwrite(&t.width, 4, 1, f);
+            fwrite(t.data.data(), 4, t.data.size(), f);
+            uint32_t na = uint32_t(t.accum.size()), nb = uint32_t(t.accum2.size()),
+                     ns = uint32_t(t.steps.size());
+            fwrite(&na, 4, 1, f);
+            fwrite(t.accum.data(), 4, na, f);
+            fwrite(&nb, 4, 1, f);
+            fwrite(t.accum2.data(), 4, nb, f);
+            fwrite(&ns, 4, 1, f);
+            fwrite(t.steps.data(), 4, ns, f);
             fclose(f);
           }
+        }
+        if (!write_all(fd, &ok, 1)) break;
+      } else if (op == 5) {  // CONFIG (per-table server-side optimizer)
+        uint8_t optc;
+        float eps, b1, b2;
+        if (!read_all(fd, &optc, 1) || !read_all(fd, &eps, 4) ||
+            !read_all(fd, &b1, 4) || !read_all(fd, &b2, 4))
+          break;
+        std::lock_guard<std::mutex> lk(tables_mu);
+        auto it = tables.find(table);
+        if (it == tables.end() || optc > kAdam) {
+          ok = 0;
+        } else {
+          it->second.opt = optc;
+          it->second.eps = eps;
+          it->second.beta1 = b1;
+          it->second.beta2 = b2;
+        }
+        if (!write_all(fd, &ok, 1)) break;
+      } else if (op == 6) {  // LOAD (restart recovery from a SAVE snapshot)
+        uint16_t plen;
+        if (!read_all(fd, &plen, 2)) break;
+        std::string path(plen, '\0');
+        if (plen && !read_all(fd, &path[0], plen)) break;
+        std::lock_guard<std::mutex> lk(tables_mu);
+        FILE* f = fopen(path.c_str(), "rb");
+        if (!f) {
+          ok = 0;
+        } else {
+          char magic[4] = {0, 0, 0, 0};
+          uint32_t rows = 0, width = 0;
+          Table t;
+          bool good = fread(magic, 1, 4, f) == 4;
+          if (good && memcmp(magic, "PSV2", 4) == 0) {
+            good = fread(&t.opt, 4, 1, f) == 1 && fread(&t.eps, 4, 1, f) == 1 &&
+                   fread(&t.beta1, 4, 1, f) == 1 && fread(&t.beta2, 4, 1, f) == 1 &&
+                   fread(&rows, 4, 1, f) == 1 && fread(&width, 4, 1, f) == 1;
+          } else if (good) {
+            // legacy V1: the 4 magic bytes were rows; next 4 are width
+            memcpy(&rows, magic, 4);
+            good = fread(&width, 4, 1, f) == 1;
+          }
+          if (good && rows && width && size_t(rows) * width < (size_t(1) << 31)) {
+            t.rows = rows;
+            t.width = width;
+            t.data.resize(size_t(rows) * width);
+            std::vector<std::mutex> locks(rows);
+            t.row_locks.swap(locks);
+            good = fread(t.data.data(), 4, t.data.size(), f) == t.data.size();
+            if (good && memcmp(magic, "PSV2", 4) == 0) {
+              uint32_t n = 0;
+              if (fread(&n, 4, 1, f) == 1 && n) {
+                t.accum.resize(n);
+                good = fread(t.accum.data(), 4, n, f) == n;
+              }
+              if (good && fread(&n, 4, 1, f) == 1 && n) {
+                t.accum2.resize(n);
+                good = fread(t.accum2.data(), 4, n, f) == n;
+              }
+              if (good && fread(&n, 4, 1, f) == 1 && n) {
+                t.steps.resize(n);
+                good = fread(t.steps.data(), 4, n, f) == n;
+              }
+            }
+            if (good) {
+              tables.erase(table);
+              tables.emplace(table, std::move(t));
+            } else {
+              ok = 0;
+            }
+          } else {
+            ok = 0;
+          }
+          fclose(f);
         }
         if (!write_all(fd, &ok, 1)) break;
       } else if (op == 4) {  // SHUTDOWN

@@ -38,6 +38,15 @@ class DataToLoDTensorConverter:
                     except ValueError:
                         pass
             return arr
+        if self.lod_level >= 2:
+            # nested samples: each sample is a list of innermost sequences
+            from .lod import create_lod_array
+
+            groups = [
+                [np.asarray(s, dtype=np_dtype(self.dtype)) for s in sample]
+                for sample in self.data
+            ]
+            return create_lod_array(groups, None)
         seqs = [np.asarray(d, dtype=np_dtype(self.dtype)) for d in self.data]
         return pack_sequences(seqs, dtype=np_dtype(self.dtype))
 

@@ -136,6 +136,18 @@ def _reader_creator(split, size, word_idx=None):
     return reader
 
 
+def purge_cache():
+    """Free the tokenized aclImdb corpus and dict caches.
+
+    Real mode holds the 50k-doc token corpus in memory for the process
+    (the reference re-streams the tarball per epoch to bound memory, at
+    the cost of a full tar parse every epoch).  Call this after the
+    readers you need have built their encoded caches — subsequent NEW
+    creators will re-stream the archive."""
+    global _real_cache
+    _real_cache = None
+
+
 def train(word_idx=None):
     return _reader_creator("train", TRAIN_SIZE, word_idx)
 

@@ -5,6 +5,12 @@ If the real archive is present at ``DATA_HOME/movielens/ml-1m.zip``
 ``movies.dat`` / ``users.dat`` / ``ratings.dat`` with '::' separators and
 latin-1 encoding, categories and title words indexed into dicts built
 from the data, ratings split 90/10 train/test by a deterministic hash.
+NOTE: the reference samples its ~10% test split with a seeded RNG
+(np.random over the shuffled ratings); here membership is decided by
+``(uid*2654435761 + mid) % 10 == 0`` instead, so *which* samples land in
+test differs from the reference on the same ml-1m data (the split sizes
+and schema match; the hash keeps the split stable without materializing
+the full ratings list).
 Otherwise: synthetic users/movies with the same feature schema —
 (user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
 rating), all int64 lists/scalars + float rating in [1, 5].

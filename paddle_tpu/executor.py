@@ -273,6 +273,17 @@ class LoweringContext:
         ln = src + "@LENGTHS"
         if ln in self.env:
             self.env[dst + "@LENGTHS"] = self.env[ln]
+        sln = src + "@SUBLENGTHS"
+        if sln in self.env:
+            self.env[dst + "@SUBLENGTHS"] = self.env[sln]
+
+    # outer-level (lod level 0) companions for nested LoD: counts of rows
+    # per outer group (lod.py nested convention)
+    def get_sub_lengths(self, name: str, default=None):
+        return self.env.get(name + "@SUBLENGTHS", default)
+
+    def set_sub_lengths(self, name: str, sub_lengths):
+        self.env[name + "@SUBLENGTHS"] = sub_lengths
 
     def child(self, env):
         c = LoweringContext.__new__(LoweringContext)
@@ -336,6 +347,7 @@ def _propagate_lengths(ctx: LoweringContext, op):
     if op.type in _LENGTH_AWARE_OPS:
         return
     src = None
+    src_name = None
     for names in op.inputs.values():
         for n in names:
             lens = ctx.env.get(n + "@LENGTHS")
@@ -343,12 +355,14 @@ def _propagate_lengths(ctx: LoweringContext, op):
                 v = ctx.env.get(n)
                 if v is not None and getattr(v, "ndim", 0) >= 2:
                     src = (v.shape[:2], lens)
+                    src_name = n
                     break
         if src:
             break
     if not src:
         return
     lead, lens = src
+    sub = ctx.env.get(src_name + "@SUBLENGTHS")
     for names in op.outputs.values():
         for n in names:
             if n + "@LENGTHS" in ctx.env:
@@ -356,6 +370,8 @@ def _propagate_lengths(ctx: LoweringContext, op):
             v = ctx.env.get(n)
             if v is not None and getattr(v, "ndim", 0) >= 2 and tuple(v.shape[:2]) == tuple(lead):
                 ctx.env[n + "@LENGTHS"] = lens
+                if sub is not None and n + "@SUBLENGTHS" not in ctx.env:
+                    ctx.env[n + "@SUBLENGTHS"] = sub
 
 
 _NAN_DEBUG = {"on": False}
@@ -381,13 +397,23 @@ def _nan_probe(op_type, var_name, value):
 
 
 def interpret_ops(ctx: LoweringContext, ops):
-    """Straight-line trace of an op list (no backward meta-op)."""
+    """Straight-line trace of an op list (no backward meta-op).
+
+    Every op's lowering is wrapped in ``jax.named_scope(op.type)`` so the
+    XLA/HLO metadata carries the Program op that produced each fused
+    instruction — the analog of the reference profiler's per-op device
+    attribution (paddle/fluid/platform/profiler.cc), but on the REAL
+    compiled step: xprof traces and compiled-HLO dumps map fusions back to
+    op types by scope name."""
     import functools
+
+    import jax
 
     for op in ops:
         rule = get_rule(op.type)
-        rule(ctx, op)
-        _propagate_lengths(ctx, op)
+        with jax.named_scope(op.type):
+            rule(ctx, op)
+            _propagate_lengths(ctx, op)
         if _NAN_DEBUG["on"]:
             import jax
             import jax.numpy as jnp
@@ -628,8 +654,16 @@ class Executor:
         key_owner = scope._owner("__rng_key__") or scope
         key_owner.vars["__rng_key__"] = new_key
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+            return [np.asarray(v) for v, _ln, _sln in fetches]
+        out = []
+        for v, ln, sln in fetches:
+            if ln is not None:
+                out.append(LoDArray(
+                    np.asarray(v), np.asarray(ln),
+                    None if sln is None else np.asarray(sln)))
+            else:
+                out.append(v)
+        return out
 
     # -- internals -----------------------------------------------------------
     def _pserver_clients(self, program):
@@ -746,7 +780,11 @@ class Executor:
             for f in fetch_names:
                 if f not in env:
                     raise KeyError("fetch target %r was not produced by the program" % f)
-                fetches.append(env[f])
+                # carry the ragged companions out so run() can hand back a
+                # structured LoDArray (reference: fetched LoDTensors keep
+                # their lod when return_numpy=False)
+                fetches.append(
+                    (env[f], env.get(f + "@LENGTHS"), env.get(f + "@SUBLENGTHS")))
             new_state = {n: v for n, v in env.items() if n in persistable_names}
             return fetches, new_state, next_key
 

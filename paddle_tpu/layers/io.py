@@ -76,16 +76,24 @@ class _PyReader:
     def start(self):
         import threading
 
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "py_reader already started — call reset() before start()ing "
+                "again (two producers on one queue desynchronize epochs)")
         self._stop = False
+        # the worker closes over ITS queue: after reset() swaps self.queue,
+        # a producer that outlived the join timeout can only touch the old
+        # (discarded) queue, never poison the new epoch with its sentinel
+        q = self.queue
 
         def worker():
             try:
                 for item in self._reader():
                     if self._stop:
                         return
-                    self.queue.put(item)
+                    q.put(item)
             finally:
-                self.queue.put(None)
+                q.put(None)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()

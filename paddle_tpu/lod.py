@@ -9,6 +9,17 @@ the MXU.  Here a batch of ragged sequences is a *dense padded* array
 sequence ops are mask-aware.  ``LoDArray`` is the host-side container the
 DataFeeder produces and the Executor feeds as two device arrays
 (``name`` and ``name@LENGTHS``).
+
+Nested (2-level) convention — rows are the INNERMOST sequences:
+``data[row]`` is one padded innermost sequence, ``lengths[row]`` its token
+count (identical to the 1-level case, so every mask-aware sequence op works
+on a nested tensor unchanged), and ``sub_lengths[g]`` counts how many rows
+belong to outer group g (``sum(sub_lengths) == data.shape[0]``).  The
+reference's offset-LoD ``[[outer], [inner]]`` (lod_tensor.py:24-99) maps to
+``recursive_sequence_lengths() == [sub_lengths, lengths]`` — level 0 is the
+outermost, as in the reference.  The Executor feeds a third device array
+``name@SUBLENGTHS`` for ops that consume the outer level
+(``sequence_expand(ref_level=0)``, ``beam_search_decode``).
 """
 from __future__ import annotations
 
@@ -40,10 +51,11 @@ class LoDArray:
         return 1 if self.sub_lengths is None else 2
 
     def recursive_sequence_lengths(self):
-        lens = [self.lengths.tolist()]
+        """Reference order: level 0 outermost.  Nested -> [outer group row
+        counts, per-row token lengths]; flat -> [per-row token lengths]."""
         if self.sub_lengths is not None:
-            lens.append(self.sub_lengths.tolist())
-        return lens
+            return [self.sub_lengths.tolist(), self.lengths.tolist()]
+        return [self.lengths.tolist()]
 
     # -- reference LoDTensor method surface (pybind lod_tensor) --------------
     def set(self, data, place=None):
@@ -56,8 +68,11 @@ class LoDArray:
         if len(levels) > 2:
             raise ValueError(
                 "LoDArray supports at most 2 LoD levels, got %d" % len(levels))
-        self.lengths = levels[0]
-        self.sub_lengths = levels[1] if len(levels) > 1 else None
+        if len(levels) == 2:
+            # level 0 = outer group counts, level 1 = innermost (per-row)
+            self.sub_lengths, self.lengths = levels[0], levels[1]
+        else:
+            self.lengths, self.sub_lengths = levels[0], None
         return self
 
     def has_valid_recursive_sequence_lengths(self):
@@ -67,6 +82,11 @@ class LoDArray:
             return False
         if self.lengths.size and (self.lengths < 0).any():
             return False
+        if self.sub_lengths is not None:
+            if (self.sub_lengths < 0).any():
+                return False
+            if int(self.sub_lengths.sum()) != self.data.shape[0]:
+                return False
         max_len = self.data.shape[1] if self.data.ndim > 1 else 0
         return not (self.lengths.size and int(self.lengths.max()) > max_len)
 
@@ -118,6 +138,14 @@ def create_lod_array(data, recursive_seq_lens=None, place=None) -> LoDArray:
     if isinstance(data, LoDArray):
         return data
     if isinstance(data, (list, tuple)) and recursive_seq_lens is None:
+        # list of per-sequence arrays, or list of groups of per-sequence
+        # arrays (nested): [[seq, seq], [seq]] -> 2-level
+        if data and isinstance(data[0], (list, tuple)):
+            counts = np.array([len(g) for g in data], np.int32)
+            flat = [np.asarray(s) for g in data for s in g]
+            out = pack_sequences(flat)
+            out.sub_lengths = counts
+            return out
         return pack_sequences(data)
     data = np.asarray(data)
     if recursive_seq_lens is None:
@@ -127,7 +155,25 @@ def create_lod_array(data, recursive_seq_lens=None, place=None) -> LoDArray:
         offs = np.concatenate([[0], np.cumsum(lens)])
         seqs = [data[offs[i]: offs[i + 1]] for i in range(len(lens))]
         return pack_sequences(seqs)
-    raise NotImplementedError("nested lod>1 flat construction; pass per-item lists instead")
+    if len(recursive_seq_lens) == 2:
+        # reference flat layout (lod_tensor.py:24): data concatenates all
+        # innermost tokens; level 0 counts inner sequences per outer item,
+        # level 1 holds each inner sequence's token count
+        outer, inner = recursive_seq_lens
+        if int(np.sum(outer)) != len(inner):
+            raise ValueError(
+                "recursive_seq_lens inconsistent: outer counts sum to %d but "
+                "%d inner lengths given" % (int(np.sum(outer)), len(inner)))
+        if int(np.sum(inner)) != data.shape[0]:
+            raise ValueError(
+                "recursive_seq_lens inconsistent: inner lengths sum to %d but "
+                "data has %d rows" % (int(np.sum(inner)), data.shape[0]))
+        offs = np.concatenate([[0], np.cumsum(inner)])
+        seqs = [data[offs[i]: offs[i + 1]] for i in range(len(inner))]
+        out = pack_sequences(seqs)
+        out.sub_lengths = np.asarray(outer, np.int32)
+        return out
+    raise ValueError("LoDArray supports at most 2 LoD levels, got %d" % len(recursive_seq_lens))
 
 
 class LoDTensorArray(list):
@@ -148,10 +194,19 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None, low=0, high=10):
     """Random int LoD tensor (reference lod_tensor.py:74): one sequence per
-    entry of the last-level lengths, values in [low, high]."""
+    entry of the last-level lengths, values in [low, high]; outer levels are
+    kept as the nested grouping."""
     lens = list(recursive_seq_lens[-1])
     seqs = [
         np.random.randint(low, high + 1, size=[L] + list(base_shape)).astype("int64")
         for L in lens
     ]
-    return pack_sequences(seqs)
+    out = pack_sequences(seqs)
+    if len(recursive_seq_lens) == 2:
+        outer = np.asarray(recursive_seq_lens[0], np.int32)
+        if int(outer.sum()) != len(lens):
+            raise ValueError(
+                "outer counts sum to %d but %d inner sequences given"
+                % (int(outer.sum()), len(lens)))
+        out.sub_lengths = outer
+    return out

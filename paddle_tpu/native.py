@@ -160,3 +160,109 @@ class NativeLoader:
             self.close()
         except Exception:
             pass
+
+
+class SparsePSClient:
+    """Wire-protocol client for the C++ sparse pserver (csrc/pserver.cc;
+    reference analog: go/pserver/client).  One TCP connection, blocking
+    request/response.  The update rule runs SERVER-side: ``configure``
+    selects SGD/Adagrad/Adam per table (reference go/pserver/optimizer.go),
+    ``push`` ships raw gradients with the learning rate, ``save``/``load``
+    snapshot and restore the table INCLUDING optimizer state so a restarted
+    pserver resumes training without losing learned rows."""
+
+    OPT_SGD, OPT_ADAGRAD, OPT_ADAM = 0, 1, 2
+
+    def __init__(self, host, port, timeout=30.0):
+        import socket
+
+        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+
+    def _hdr(self, op, table):
+        import struct
+
+        t = table.encode() if isinstance(table, str) else table
+        return struct.pack("<BH", op, len(t)) + t
+
+    def _status(self):
+        b = self.sock.recv(1)
+        if len(b) != 1:
+            raise IOError("pserver closed connection")
+        return b == b"\x01"
+
+    def init_table(self, table, rows, width):
+        import struct
+
+        self.sock.sendall(self._hdr(0, table) + struct.pack("<II", rows, width))
+        return self._status()
+
+    def configure(self, table, optimizer="sgd", eps=1e-8, beta1=0.9, beta2=0.999):
+        import struct
+
+        opt = {"sgd": 0, "adagrad": 1, "adam": 2}[optimizer]
+        self.sock.sendall(
+            self._hdr(5, table) + struct.pack("<Bfff", opt, eps, beta1, beta2))
+        return self._status()
+
+    def push(self, table, row_ids, grads, lr):
+        import struct
+
+        import numpy as np
+
+        g = np.ascontiguousarray(grads, dtype=np.float32)
+        ids = np.ascontiguousarray(row_ids, dtype=np.uint32).reshape(-1)
+        n, width = g.shape if g.ndim == 2 else (1, g.shape[0])
+        g = g.reshape(n, width)
+        assert len(ids) == n, (len(ids), n)
+        msg = self._hdr(1, table) + struct.pack("<fII", float(lr), width, n)
+        parts = [msg]
+        for i in range(n):
+            parts.append(struct.pack("<I", int(ids[i])) + g[i].tobytes())
+        self.sock.sendall(b"".join(parts))
+        return self._status()
+
+    def pull(self, table, row_ids, width):
+        import struct
+
+        import numpy as np
+
+        ids = np.ascontiguousarray(row_ids, dtype=np.uint32).reshape(-1)
+        self.sock.sendall(
+            self._hdr(2, table) + struct.pack("<I", len(ids)) + ids.tobytes())
+        if not self._status():
+            raise KeyError("unknown table %r" % table)
+        need = len(ids) * width * 4
+        buf = b""
+        while len(buf) < need:
+            chunk = self.sock.recv(need - len(buf))
+            if not chunk:
+                raise IOError("pserver closed connection mid-pull")
+            buf += chunk
+        return np.frombuffer(buf, np.float32).reshape(len(ids), width).copy()
+
+    def save(self, table, path):
+        import struct
+
+        p = path.encode()
+        self.sock.sendall(self._hdr(3, table) + struct.pack("<H", len(p)) + p)
+        return self._status()
+
+    def load(self, table, path):
+        import struct
+
+        p = path.encode()
+        self.sock.sendall(self._hdr(6, table) + struct.pack("<H", len(p)) + p)
+        return self._status()
+
+    def shutdown_server(self):
+        try:
+            self.sock.sendall(self._hdr(4, ""))
+            self._status()
+        except OSError:
+            pass
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

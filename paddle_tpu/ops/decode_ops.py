@@ -99,5 +99,24 @@ def _beam_search_decode(ctx, op):
     # Final per-lane scores: read the last valid step's scores.
     last = jnp.clip(n - 1, 0, T - 1)
     sentence_scores = scores_buf[last]  # [B, beam]
-    ctx.set_output(op, "SentenceIds", sentence_ids)
-    ctx.set_output(op, "SentenceScores", sentence_scores)
+
+    # 2-level LoD output, reference parity (beam_search_decode_op.cc emits
+    # lod [[source offsets], [hypothesis token offsets]]): rows are the
+    # hypotheses ([B*beam, T]), @LENGTHS holds each hypothesis' token count
+    # (up to and including the first end_id; n if it never finished), and
+    # @SUBLENGTHS groups beam rows per source sentence.
+    flat = sentence_ids.reshape(B * beam, T)
+    is_end = flat == end_id
+    any_end = is_end.any(axis=1)
+    first_end = jnp.argmax(is_end, axis=1)  # first True, 0 if none
+    # padding steps (>= n) also read end_id, so clamp to n: an unfinished
+    # hypothesis has n real tokens, a finished one ends at its end_id
+    hyp_len = jnp.minimum(jnp.where(any_end, first_end + 1, n), n).astype(jnp.int32)
+    out_name = op.outputs["SentenceIds"][0]
+    ctx.set_output(op, "SentenceIds", flat)
+    ctx.set_lengths(out_name, hyp_len)
+    ctx.set_sub_lengths(out_name, jnp.full((B,), beam, dtype=jnp.int32))
+    ctx.set_output(op, "SentenceScores", sentence_scores.reshape(B * beam))
+    sc_name = op.outputs["SentenceScores"][0]
+    ctx.set_lengths(sc_name, jnp.ones((B * beam,), jnp.int32))
+    ctx.set_sub_lengths(sc_name, jnp.full((B,), beam, dtype=jnp.int32))

@@ -186,14 +186,43 @@ def _row_conv(ctx, op):
 @register("sequence_expand")
 def _sequence_expand(ctx, op):
     """Expand x by y's sequence structure (reference sequence_expand_op).
+
     Padded-layout cases: x one step per batch row (the attention/seq2seq use)
     -> broadcast over y's time axis; x already [B, T, ...] -> re-masked to
-    y's lengths."""
+    y's lengths.
+
+    ``ref_level=0`` against a NESTED y (reference nn.py:2660 with a 2-level
+    y): x's row i (one sequence per outer group of y) is repeated
+    ``counts[i]`` times, where counts = y@SUBLENGTHS — a static-shape row
+    gather, since sum(counts) == y's row count by the nested invariant."""
     jnp = _jnp()
     x = ctx.get_input(op, "X")
     yname = op.inputs["Y"][0]
     y = ctx.get(yname)
     ylens = ctx.get_lengths(yname)
+    ysub = ctx.get_sub_lengths(yname)
+    ref_level = int(op.attrs.get("ref_level", -1))
+
+    if ref_level == 0 and ysub is not None:
+        counts = jnp.asarray(ysub).reshape(-1).astype(jnp.int32)
+        n_rows = y.shape[0]
+        # row j of the output comes from x's row g(j): the outer group j
+        # falls into.  repeat is static-shaped via total_repeat_length.
+        gidx = jnp.repeat(
+            jnp.arange(counts.shape[0], dtype=jnp.int32), counts,
+            total_repeat_length=n_rows)
+        out = jnp.take(x, gidx, axis=0)
+        ctx.set_output(op, "Out", out)
+        xlens = ctx.get_lengths(op.inputs["X"][0])
+        if xlens is not None:
+            ctx.set_lengths(op.outputs["Out"][0], jnp.take(jnp.asarray(xlens).reshape(-1), gidx))
+        elif x.ndim >= 2:
+            ctx.set_lengths(
+                op.outputs["Out"][0],
+                jnp.full((n_rows,), x.shape[1], dtype=jnp.int32))
+        ctx.set_sub_lengths(op.outputs["Out"][0], counts)
+        return
+
     if ylens is None:
         ylens = jnp.full((y.shape[0],), y.shape[1], dtype=jnp.int32)
     T = y.shape[1]

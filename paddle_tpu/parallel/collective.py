@@ -31,6 +31,13 @@ __all__ = [
 ]
 
 
+# Tracks whether THIS module initialized jax.distributed, so repeat calls
+# and teardown are classified by state rather than by parsing exception
+# text (brittle across jax versions; a real failure whose message happens
+# to contain "already"/"not initialized" must not be swallowed).
+_DIST_STATE = {"initialized": False}
+
+
 def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
     """Join this host to the multi-host runtime (the analog of the
     reference's trainer/pserver endpoint wiring, but for SPMD: after this,
@@ -59,30 +66,23 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
             "multi-process init needs coordinator_address (or PADDLE_COORDINATOR)")
     if num_processes == 1 and not coordinator_address:
         return  # single host, no coordinator requested: nothing to wire up
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:
-        # repeat initialization is a documented no-op
-        msg = str(e).lower()
-        if "already" not in msg and "once" not in msg:
-            raise
+    if _DIST_STATE["initialized"]:
+        return  # repeat initialization is a documented no-op
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _DIST_STATE["initialized"] = True
 
 
 def shutdown_distributed():
     import jax
 
-    try:
-        jax.distributed.shutdown()
-    except RuntimeError as e:
-        # only the never-initialized case is benign; a failed teardown of a
-        # live multi-host runtime must surface
-        msg = str(e).lower()
-        if "not initialized" not in msg and "initialize" not in msg:
-            raise
+    if not _DIST_STATE["initialized"]:
+        return  # never initialized (by us): nothing to tear down
+    jax.distributed.shutdown()
+    _DIST_STATE["initialized"] = False
 
 
 def psum(x, axis_name):

@@ -13,7 +13,7 @@ import os
 import time
 from collections import defaultdict
 
-__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event", "is_profiling", "record", "profile_program"]
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event", "is_profiling", "record", "profile_program", "compiled_op_report"]
 
 _timings = defaultdict(list)
 _active = {"on": False, "dir": None, "t0": None}
@@ -95,6 +95,97 @@ def format_report(sorted_key="total"):
     for r in rows:
         lines.append("%-48s %8d %12.6f %12.6f %12.6f %12.6f" % r)
     return "\n".join(lines)
+
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+                "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                "pred": 1, "c64": 8, "c128": 16}
+
+
+def _parse_hlo_op_rows(hlo_text, known_op_types):
+    """Group the optimized-HLO instructions of a compiled step by the
+    Program op that produced them, via the ``jax.named_scope(op.type)``
+    metadata the executor stamps during lowering (executor.interpret_ops).
+
+    Returns {row_name: {"instructions": n, "out_bytes": b}} where backward
+    instructions (XLA transpose/VJP replays of a forward scope) get the
+    reference's ``<op>_grad`` spelling."""
+    import re
+
+    rows = defaultdict(lambda: {"instructions": 0, "out_bytes": 0})
+    shape_re = re.compile(r"=\s+([a-z0-9]+)\[([0-9,]*)\]")
+    meta_re = re.compile(r'metadata=\{op_name="([^"]+)"')
+    # autodiff/transform tracing wraps scope names: the forward replay under
+    # value_and_grad shows as jvp(<op>), its backward as transpose(jvp(<op>))
+    wrapper_re = re.compile(r"^(?:jvp|transpose|jit|vmap|remat|custom_jvp|custom_vjp)\((.*)\)$")
+    for line in hlo_text.splitlines():
+        m = meta_re.search(line)
+        if not m:
+            continue
+        op_name = m.group(1)
+        segs = op_name.split("/")
+        op_type = None
+        for seg in reversed(segs):  # innermost named scope wins
+            base = seg.split("[", 1)[0]
+            while True:
+                w = wrapper_re.match(base)
+                if not w:
+                    break
+                base = w.group(1)
+            if base in known_op_types:
+                op_type = base
+                break
+        if op_type is None:
+            continue
+        if "transpose(" in op_name:
+            op_type += "_grad"
+        sm = shape_re.search(line)
+        nbytes = 0
+        if sm:
+            dt, dims = sm.group(1), sm.group(2)
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes = n * _DTYPE_BYTES.get(dt, 4)
+        rows[op_type]["instructions"] += 1
+        rows[op_type]["out_bytes"] += nbytes
+    return dict(rows)
+
+
+def compiled_op_report(program, feed, state=None, fetch_list=None, sorted_key="instructions"):
+    """Per-op attribution of the REAL compiled step (reference:
+    paddle/fluid/platform/profiler.cc's per-op device table).
+
+    The executor lowers the whole block into ONE fused XLA executable, so
+    per-op wall time does not exist at runtime; what the hardware actually
+    executes is fusions.  Each fusion's HLO metadata carries the
+    ``named_scope(op.type)`` stamped at trace time, so this report maps the
+    *compiled* instructions (post-fusion, the ones that run) back to
+    Program ops: instruction count and output bytes per op, ``<op>_grad``
+    rows for backward instructions.  Complements ``profile_program`` (an
+    eager per-op cost model) with ground truth about the fused step.
+
+    Returns (report_str, rows_dict).
+    """
+    import jax
+
+    from .jax_bridge import program_to_fn
+
+    fetch_names = fetch_list or []
+    fn = program_to_fn(program, fetch_names, return_state=True)
+    state = dict(state or {})
+    compiled = jax.jit(fn).lower(state, dict(feed)).compile()
+    hlo = compiled.as_text()
+    known = {op.type for op in program.global_block().ops}
+    rows = _parse_hlo_op_rows(hlo, known)
+
+    keyf = (lambda kv: -kv[1]["out_bytes"]) if sorted_key == "out_bytes" else (
+        lambda kv: -kv[1]["instructions"])
+    lines = ["%-32s %14s %16s" % ("Op", "HLO instrs", "Out bytes")]
+    for name, r in sorted(rows.items(), key=keyf):
+        lines.append("%-32s %14d %16d" % (name, r["instructions"], r["out_bytes"]))
+    return "\n".join(lines), rows
 
 
 def profile_program(program, feed, state=None, iters=10, sorted_key="total", seed=0):

@@ -166,3 +166,35 @@ def test_per_op_profile_report():
     # sorted by total time, descending
     totals = [float(ln.split()[2]) for ln in lines[1:]]
     assert totals == sorted(totals, reverse=True)
+
+
+def test_compiled_op_report_real_step():
+    """Per-op attribution on the REAL fused step (VERDICT r3 item 7): the
+    compiled HLO's metadata carries the named_scope(op.type) stamps, the
+    report maps fused instructions back to Program ops, and backward
+    instructions get <op>_grad rows."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.jax_bridge import init_state
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        lbl = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        p = fluid.layers.fc(h, size=3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=p, label=lbl))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    state = init_state(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 6).astype("float32"),
+            "y": rng.randint(0, 3, (4, 1)).astype("int64")}
+    report, rows = fluid.profiler.compiled_op_report(
+        main, feed, state=state, fetch_list=[loss])
+    # forward ops attributed in the compiled executable
+    for op_type in ("mul", "relu", "softmax"):
+        assert op_type in rows, (op_type, sorted(rows))
+        assert rows[op_type]["instructions"] >= 1
+    # backward (transposed) instructions carry the _grad spelling
+    assert any(k.endswith("_grad") for k in rows), sorted(rows)
+    assert report.splitlines()[0].split()[0] == "Op"

@@ -111,8 +111,61 @@ def test_beam_search_decode_backtrace():
         },
         fetch_list=[sent_ids, sent_scores],
     )
-    # lane0 sentence: parent chain 1 -> token 11 then 12; positions past the
-    # 2 written steps are end_id padding (static [B, beam, capacity] layout)
-    assert out_ids[0, 0].tolist() == [11, 12, 0, 0]
-    assert out_ids[0, 1].tolist() == [11, 13, 0, 0]
-    np.testing.assert_allclose(out_scores[0], [-0.4, -0.6], rtol=1e-6)
+    # rows are hypotheses ([B*beam, capacity]); lane0 sentence: parent chain
+    # 1 -> token 11 then 12; positions past the 2 written steps are end_id
+    # padding
+    assert out_ids[0].tolist() == [11, 12, 0, 0]
+    assert out_ids[1].tolist() == [11, 13, 0, 0]
+    np.testing.assert_allclose(out_scores, [-0.4, -0.6], rtol=1e-6)
+
+
+def test_beam_search_decode_nested_lod_output():
+    """return_numpy=False hands back the reference's 2-level structure:
+    rows = hypotheses, lengths = per-hypothesis token counts (through the
+    first end_id), sub_lengths = beam rows per source sentence."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        s0i = layers.data(name="s0i", shape=[2], dtype="int64")
+        s0p = layers.data(name="s0p", shape=[2], dtype="int32")
+        s0s = layers.data(name="s0s", shape=[2], dtype="float32")
+        s1i = layers.data(name="s1i", shape=[2], dtype="int64")
+        s1p = layers.data(name="s1p", shape=[2], dtype="int32")
+        s1s = layers.data(name="s1s", shape=[2], dtype="float32")
+        ids_arr = layers.create_array("int64", capacity=4)
+        sc_arr = layers.create_array("float32", capacity=4)
+        par_arr = layers.create_array("int32", capacity=4)
+        zero = layers.zeros(shape=[1], dtype="int64")
+        one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        layers.array_write(s0i, zero, ids_arr)
+        layers.array_write(s0s, zero, sc_arr)
+        layers.array_write(s0p, zero, par_arr)
+        layers.array_write(s1i, one, ids_arr)
+        layers.array_write(s1s, one, sc_arr)
+        layers.array_write(s1p, one, par_arr)
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, sc_arr, par_arr, beam_size=2, end_id=0
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got_ids, _ = exe.run(
+        main,
+        feed={
+            # lane1 finishes at step 1 (emits end_id 0); lane0 never does
+            "s0i": np.array([[10, 11]], dtype=np.int64),
+            "s0p": np.array([[0, 1]], dtype=np.int32),
+            "s0s": np.array([[-0.1, -0.2]], dtype=np.float32),
+            "s1i": np.array([[12, 0]], dtype=np.int64),
+            "s1p": np.array([[0, 1]], dtype=np.int32),
+            "s1s": np.array([[-0.4, -0.6]], dtype=np.float32),
+        },
+        fetch_list=[sent_ids, sent_scores],
+        return_numpy=False,
+    )
+    from paddle_tpu.lod import LoDArray
+
+    assert isinstance(got_ids, LoDArray)
+    assert got_ids.lod_level == 2
+    # 1 source x 2 beams; lane0 ran 2 full steps, lane1 ended at step 1
+    assert got_ids.recursive_sequence_lengths() == [[2], [2, 2]]
+    assert got_ids.has_valid_recursive_sequence_lengths()
+    assert np.asarray(got_ids.data)[1, :2].tolist() == [11, 0]

@@ -12,6 +12,16 @@ import pytest
 from paddle_tpu.parallel import collective as C
 
 
+def _free_port():
+    """Reserve an ephemeral port: bind, read the number, release it (the
+    coordinator in the subprocess rebinds it an instant later)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_validation(monkeypatch):
     monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
     with pytest.raises(ValueError, match="out of range"):
@@ -23,10 +33,11 @@ def test_validation(monkeypatch):
 
 
 def test_single_process_lifecycle():
+    port = _free_port()
     code = (
         "from paddle_tpu.parallel import collective as C\n"
-        "C.init_distributed('localhost:12361', 1, 0)\n"
-        "C.init_distributed('localhost:12361', 1, 0)  # repeat: no-op\n"
+        "C.init_distributed('localhost:%d', 1, 0)\n"
+        "C.init_distributed('localhost:%d', 1, 0)  # repeat: no-op\n" % (port, port)
         "import jax; assert jax.process_count() == 1\n"
         "C.shutdown_distributed()\n"
         "C.shutdown_distributed()\n"
@@ -43,11 +54,12 @@ def test_two_process_psum_over_localhost():
     """A real 2-process jax.distributed session: each worker brings 2 cpu
     devices, the global mesh spans 4, and a cross-process psum agrees
     (SURVEY §2.4 multi-host readiness, closed end-to-end)."""
+    port = _free_port()
     worker = (
         "import sys, functools\n"
         "import numpy as np\n"
         "from paddle_tpu.parallel import collective as C\n"
-        "C.init_distributed('localhost:12399', 2, int(sys.argv[1]))\n"
+        "C.init_distributed('localhost:%d', 2, int(sys.argv[1]))\n" % port
         "import jax, jax.numpy as jnp\n"
         "from jax.sharding import Mesh, PartitionSpec as P\n"
         "from jax import shard_map\n"

@@ -391,3 +391,88 @@ def test_slice_var_up_shards_large_param_across_pservers():
         th.join(timeout=10)
         assert not th.is_alive()
     np.testing.assert_allclose(w_dist, w_single, rtol=1e-5, atol=1e-6)
+
+
+def test_cpp_pserver_server_side_adam_and_restart_recovery():
+    """Server-side Adam (reference go/pserver/optimizer.go) matches a numpy
+    Adam reference, and a SAVE -> restart -> LOAD cycle resumes with
+    identical parameters AND optimizer state (kill-and-resume: the
+    continued run equals an uninterrupted one)."""
+    import os
+    import tempfile
+
+    from paddle_tpu.native import lib as native_lib, SparsePSClient
+
+    L = native_lib()
+    if L is None:
+        pytest.skip("native lib not built")
+
+    rng = np.random.RandomState(0)
+    rows, width, lr = 6, 5, 0.1
+    grads = [rng.randn(rows, width).astype("float32") for _ in range(6)]
+
+    # numpy Adam reference over all 6 steps
+    w = np.zeros((rows, width), "float64")
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, start=1):
+        g = g.astype("float64")
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w -= lr * (m / (1 - b1 ** t)) / (np.sqrt(v / (1 - b2 ** t)) + eps)
+
+    snap = os.path.join(tempfile.mkdtemp(), "emb.psnap")
+    ids = np.arange(rows)
+
+    # server 1: configure adam, push the first 3 steps, SAVE, die
+    h1 = L.pserver_start(0)
+    c1 = SparsePSClient("127.0.0.1", L.pserver_port(h1))
+    assert c1.init_table("emb", rows, width)
+    assert c1.configure("emb", "adam", eps=eps, beta1=b1, beta2=b2)
+    for g in grads[:3]:
+        assert c1.push("emb", ids, g, lr)
+    assert c1.save("emb", snap)
+    c1.close()
+    L.pserver_stop(h1)  # "crash": the in-memory table is gone
+
+    # server 2: LOAD the snapshot, continue with the remaining 3 steps
+    h2 = L.pserver_start(0)
+    c2 = SparsePSClient("127.0.0.1", L.pserver_port(h2))
+    assert c2.load("emb", snap)
+    for g in grads[3:]:
+        assert c2.push("emb", ids, g, lr)
+    got = c2.pull("emb", ids, width)
+    c2.close()
+    L.pserver_stop(h2)
+
+    np.testing.assert_allclose(got, w.astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_pserver_server_side_adagrad():
+    from paddle_tpu.native import lib as native_lib, SparsePSClient
+
+    L = native_lib()
+    if L is None:
+        pytest.skip("native lib not built")
+    rows, width, lr, eps = 4, 3, 0.5, 1e-8
+    rng = np.random.RandomState(1)
+    grads = [rng.randn(rows, width).astype("float32") for _ in range(4)]
+
+    w = np.zeros((rows, width), "float64")
+    acc = np.zeros_like(w)
+    for g in grads:
+        g = g.astype("float64")
+        acc += g * g
+        w -= lr * g / (np.sqrt(acc) + eps)
+
+    h = L.pserver_start(0)
+    c = SparsePSClient("127.0.0.1", L.pserver_port(h))
+    assert c.init_table("t", rows, width)
+    assert c.configure("t", "adagrad", eps=eps)
+    for g in grads:
+        assert c.push("t", np.arange(rows), g, lr)
+    got = c.pull("t", np.arange(rows), width)
+    c.close()
+    L.pserver_stop(h)
+    np.testing.assert_allclose(got, w.astype("float32"), rtol=1e-4, atol=1e-5)

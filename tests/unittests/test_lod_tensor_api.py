@@ -57,3 +57,52 @@ def test_create_random_int_lodtensor():
     assert t.recursive_sequence_lengths() == [[2, 3, 1]]
     vals = np.concatenate(unpack_sequences(t), axis=0)
     assert vals.min() >= 1 and vals.max() <= 9
+
+
+def test_create_lod_tensor_nested_flat():
+    """Reference lod_tensor.py:24-99 2-level flat construction: data holds
+    all innermost tokens concatenated; level 0 counts inner sequences per
+    outer item, level 1 each inner sequence's token count."""
+    flat = np.arange(12, dtype="float32").reshape(12, 1)
+    t = fluid.create_lod_tensor(flat, [[2, 3], [2, 1, 2, 3, 4]])
+    assert t.lod_level == 2
+    assert t.shape[0] == 5  # rows = innermost sequences
+    assert t.recursive_sequence_lengths() == [[2, 3], [2, 1, 2, 3, 4]]
+    assert t.has_valid_recursive_sequence_lengths()
+    assert t.lod() == [[0, 2, 5], [0, 2, 3, 5, 8, 12]]
+    np.testing.assert_array_equal(t.data[1, :1], flat[2:3])
+    np.testing.assert_array_equal(t.data[4, :4], flat[8:12])
+
+
+def test_create_lod_tensor_nested_list_of_lists():
+    groups = [
+        [np.ones(2, "float32"), np.zeros(1, "float32")],
+        [np.full(3, 2.0, "float32")],
+    ]
+    t = fluid.create_lod_tensor(groups, None)
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 1, 3]]
+
+
+def test_create_lod_tensor_nested_inconsistent_raises():
+    flat = np.arange(6, dtype="float32").reshape(6, 1)
+    with pytest.raises(ValueError, match="inconsistent"):
+        fluid.create_lod_tensor(flat, [[2], [2, 1]])  # inner sums to 3 != 6
+    with pytest.raises(ValueError, match="inconsistent"):
+        fluid.create_lod_tensor(flat, [[3], [4, 2]])  # outer says 3 inner seqs
+
+
+def test_create_random_int_lodtensor_nested():
+    t = fluid.create_random_int_lodtensor([[2, 1], [2, 3, 1]], base_shape=[2])
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 3, 1]]
+    assert t.has_valid_recursive_sequence_lengths()
+
+
+def test_nested_set_lod_offsets_roundtrip():
+    t = pack_sequences([np.ones(2), np.ones(4), np.ones(1)])
+    t.set_lod([[0, 2, 3], [0, 2, 6, 7]])
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 4, 1]]
+    assert t.lod() == [[0, 2, 3], [0, 2, 6, 7]]
+    assert t.has_valid_recursive_sequence_lengths()

@@ -70,9 +70,20 @@ def test_seq2seq_beam_search_generates():
     rng = np.random.RandomState(0)
     src = _feed(rng, 40, 4, 8, MT.PAD_IDX, 6)
     ids, scores = exe.run(g["main"], feed={"src_word": src}, fetch_list=[g["ids"], g["scores"]])
-    assert ids.shape == (4, 3, 6)
-    assert scores.shape == (4, 3)
-    # beams are sorted best-first
-    assert np.all(np.diff(scores, axis=1) <= 1e-5)
+    # rows are hypotheses (2-level LoD contract): 4 sources x 3 beams
+    assert ids.shape == (12, 6)
+    assert scores.shape == (12,)
+    # beams are sorted best-first within each source
+    assert np.all(np.diff(scores.reshape(4, 3), axis=1) <= 1e-5)
     # all generated ids are valid vocab entries
     assert ids.min() >= 0 and ids.max() < 40
+
+    # the structured view carries the full nested lod
+    got = exe.run(g["main"], feed={"src_word": src}, fetch_list=[g["ids"]],
+                  return_numpy=False)[0]
+    from paddle_tpu.lod import LoDArray
+
+    assert isinstance(got, LoDArray)
+    assert got.lod_level == 2
+    assert got.recursive_sequence_lengths()[0] == [3, 3, 3, 3]
+    assert got.has_valid_recursive_sequence_lengths()

@@ -35,6 +35,41 @@ def test_sequence_expand_as_forward():
     check_output(build, {"x": x, "y": y}, want, rtol=1e-6)
 
 
+def test_sequence_expand_ref_level0_nested():
+    """Reference nn.py:2660 example: x's sequence i is repeated per y's
+    level-0 count.  In the padded layout: rows of x (one per outer group of
+    y) are gathered so out's rows align with y's innermost sequences."""
+    import paddle_tpu as fluid
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 3).astype("float32")  # 2 outer groups, padded T=4
+    x_lod = fluid.create_lod_tensor([x[0, :2], x[1, :4]], None)
+    # y nested: group0 has 3 inner seqs, group1 has 2
+    y = fluid.create_lod_tensor(
+        [[np.ones(2), np.ones(1), np.ones(2)], [np.ones(3), np.ones(1)]], None)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = L.data(name="x", shape=[-1, -1, 3], dtype="float32")
+        yv = L.data(name="y", shape=[-1, -1], dtype="float32")
+        out = L.sequence_expand(xv, yv, ref_level=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run(main, feed={"x": x_lod, "y": y}, fetch_list=[out],
+                  return_numpy=False)[0]
+    from paddle_tpu.lod import LoDArray
+
+    assert isinstance(got, LoDArray)
+    # out rows follow y's 5 innermost sequences: x row0 x3, x row1 x2
+    assert got.data.shape[0] == 5
+    np.testing.assert_allclose(np.asarray(got.data)[0], x_lod.data[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.data)[2], x_lod.data[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.data)[3], x_lod.data[1], rtol=1e-6)
+    # lengths gathered from x, outer grouping from y
+    assert np.asarray(got.lengths).tolist() == [2, 2, 2, 4, 4]
+    assert np.asarray(got.sub_lengths).tolist() == [3, 2]
+
+
 def test_sequence_scatter_forward_grad():
     rng = np.random.RandomState(2)
     x = rng.randn(2, 6).astype("float32")

@@ -49,9 +49,10 @@ def test_transformer_fast_decode_copy_task():
 
         ids, scores = exe.run(inf["infer"], feed={"src_word": src_seq},
                               fetch_list=[inf["ids"], inf["scores"]])
-    # ids: [B, beam, T]; best beam reproduces the source body then EOS
-    assert ids.shape[0] == B
-    best = ids[:, 0, :]
+    # ids: [B*beam, T] rows-as-hypotheses (2-level LoD contract); best beam
+    # is each source's row 0
+    assert ids.shape[0] == B * 2
+    best = ids.reshape(B, 2, -1)[:, 0, :]
     correct = 0
     for b in range(B):
         want = list(body[b]) + [T.EOS_IDX]
