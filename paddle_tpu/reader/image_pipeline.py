@@ -1,0 +1,305 @@
+"""ImageNet-style real-image input pipeline: jpeg -> recordio -> C++
+loader -> decode/augment workers -> batched feeds.
+
+Reference analog: benchmark/fluid/imagenet_reader.py:1-344 (PIL decode,
+resize-short + center crop for eval, area/aspect random crop + flip +
+color jitter for train, mean/std normalize, multi-worker mapping) and
+benchmark/fluid's recordio converter.  Rebuilt TPU-first:
+
+- storage is RecordIO shards of raw jpeg bytes + label (csrc/recordio.cc),
+  scanned by the threaded shuffling C++ prefetch loader
+  (csrc/dataloader.cc) when the native lib is built, pure-python reader
+  otherwise;
+- decode + augment run in a thread pool (PIL releases the GIL in its
+  decode/resize/transform C paths) sized to hide decode latency behind the
+  device step — the whole pipeline is host-side and overlaps TPU compute;
+- every augmentation draws from a per-sample ``np.random.Generator`` seeded
+  by (epoch seed, sample index): reproducible regardless of worker count
+  or interleaving, unlike a shared global RNG.
+
+Zero-egress environments: ``synthesize_jpeg_corpus`` writes a real JPEG
+corpus (via PIL) so the byte-identical decode path is exercised without
+the archives; if ``DATA_HOME`` holds the real flowers archive
+(102flowers.tgz + imagelabels.mat-free label scheme: class per directory
+prefix), ``flowers_records`` converts it instead.
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+import threading
+
+import numpy as np
+
+__all__ = [
+    "process_image",
+    "synthesize_jpeg_corpus",
+    "convert_images_to_recordio",
+    "flowers_records",
+    "image_pipeline",
+    "batched_images",
+    "IMG_MEAN",
+    "IMG_STD",
+]
+
+IMG_MEAN = np.array([0.485, 0.456, 0.406], np.float32).reshape(3, 1, 1)
+IMG_STD = np.array([0.229, 0.224, 0.225], np.float32).reshape(3, 1, 1)
+
+
+# ---------------------------------------------------------------------------
+# decode + augment (PIL; per-sample Generator for reproducibility)
+# ---------------------------------------------------------------------------
+
+
+def _resize_short(img, target):
+    w, h = img.size
+    scale = float(target) / min(w, h)
+    from PIL import Image
+
+    return img.resize((max(1, int(round(w * scale))), max(1, int(round(h * scale)))),
+                      Image.BILINEAR)
+
+
+def _center_crop(img, size):
+    w, h = img.size
+    x0 = (w - size) // 2
+    y0 = (h - size) // 2
+    return img.crop((x0, y0, x0 + size, y0 + size))
+
+
+def _random_area_crop(img, size, gen, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Sample a crop by target area fraction and aspect ratio (the standard
+    Inception-style crop the reference uses), then resize to size x size."""
+    from PIL import Image
+
+    w, h = img.size
+    area = w * h
+    for _ in range(10):
+        target_area = area * gen.uniform(*scale)
+        aspect = np.exp(gen.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if cw <= w and ch <= h:
+            x0 = int(gen.integers(0, w - cw + 1))
+            y0 = int(gen.integers(0, h - ch + 1))
+            return img.crop((x0, y0, x0 + cw, y0 + ch)).resize((size, size), Image.BILINEAR)
+    # fallback: central square
+    return _center_crop(_resize_short(img, size), size)
+
+
+def _jitter_color(img, gen, lo=0.5, hi=1.5):
+    from PIL import ImageEnhance
+
+    enhancers = [ImageEnhance.Brightness, ImageEnhance.Contrast, ImageEnhance.Color]
+    for i in gen.permutation(3):
+        img = enhancers[int(i)](img).enhance(float(gen.uniform(lo, hi)))
+    return img
+
+
+def process_image(jpeg_bytes, mode="train", image_size=224, gen=None,
+                  color_jitter=False):
+    """jpeg bytes -> normalized CHW float32 (reference
+    imagenet_reader.process_image behavior: train = random area crop +
+    flip (+ jitter); eval = resize-short 256 + center crop)."""
+    from PIL import Image
+
+    if gen is None:
+        gen = np.random.default_rng(0)
+    img = Image.open(io.BytesIO(jpeg_bytes))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    if mode == "train":
+        img = _random_area_crop(img, image_size, gen)
+        if color_jitter:
+            img = _jitter_color(img, gen)
+        if int(gen.integers(0, 2)):
+            img = img.transpose(Image.FLIP_LEFT_RIGHT)
+    else:
+        img = _center_crop(_resize_short(img, int(image_size * 256 / 224)), image_size)
+    arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
+    return (arr - IMG_MEAN) / IMG_STD
+
+
+# ---------------------------------------------------------------------------
+# corpus -> recordio
+# ---------------------------------------------------------------------------
+
+
+def synthesize_jpeg_corpus(directory, n=256, size=96, classes=10, seed=0,
+                           quality=85):
+    """Write n real JPEG files (PIL-encoded class-templated noise) and
+    return [(path, label)].  Exists so zero-egress environments still
+    exercise the byte-level jpeg decode path."""
+    from PIL import Image
+
+    os.makedirs(directory, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0, 255, size=(classes, 3, 4, 4))
+    out = []
+    for i in range(n):
+        label = int(rng.integers(0, classes))
+        base = np.kron(templates[label], np.ones((size // 4, size // 4)))
+        noisy = np.clip(base + rng.normal(0, 20, base.shape), 0, 255)
+        img = Image.fromarray(noisy.transpose(1, 2, 0).astype(np.uint8))
+        path = os.path.join(directory, "img_%05d_c%d.jpg" % (i, label))
+        img.save(path, "JPEG", quality=quality)
+        out.append((path, label))
+    return out
+
+
+def convert_images_to_recordio(samples, path_prefix, num_shards=4,
+                               max_chunk_records=128):
+    """[(jpeg_path, label)] -> num_shards recordio files; each record is
+    label:u32 | jpeg bytes (the benchmark/fluid recordio-converter analog,
+    but storing COMPRESSED jpeg, not decoded float tensors: ~20x less disk
+    and HBM-side bandwidth, decode rides the host workers)."""
+    from ..recordio_io import COMPRESS_NONE, PyWriter
+
+    shards = ["%s-%05d" % (path_prefix, i) for i in range(num_shards)]
+    # jpeg is already entropy-coded; recompressing wastes converter time
+    writers = [PyWriter(p, max_chunk_records, COMPRESS_NONE) for p in shards]
+    for i, (path, label) in enumerate(samples):
+        with open(path, "rb") as f:
+            payload = struct.pack("<I", int(label)) + f.read()
+        writers[i % num_shards].write(payload)
+    for w in writers:
+        w.close()
+    return shards
+
+
+def flowers_records(path_prefix, num_shards=4, data_dir=None, synth_n=256):
+    """RecordIO shards for the flowers corpus: the real 102flowers.tgz under
+    DATA_HOME if present (jpg members; label = hash of filename stem into
+    102 classes — the reference's imagelabels.mat needs scipy, absent
+    here), else a synthesized jpeg corpus."""
+    import tarfile
+
+    from ..dataset.common import DATA_HOME
+
+    data_dir = data_dir or os.path.join(DATA_HOME, "flowers")
+    archive = os.path.join(data_dir, "102flowers.tgz")
+    if os.path.exists(archive):
+        tmp = path_prefix + "_extract"
+        os.makedirs(tmp, exist_ok=True)
+        samples = []
+        with tarfile.open(archive, "r:gz") as tf:
+            for m in tf.getmembers():
+                if not m.isfile() or not m.name.lower().endswith(".jpg"):
+                    continue
+                stem = os.path.basename(m.name)
+                dst = os.path.join(tmp, stem)
+                if not os.path.exists(dst):
+                    with open(dst, "wb") as f:
+                        f.write(tf.extractfile(m).read())
+                samples.append((dst, hash(stem) % 102))
+    else:
+        samples = synthesize_jpeg_corpus(path_prefix + "_synth", n=synth_n)
+    return convert_images_to_recordio(samples, path_prefix, num_shards)
+
+
+# ---------------------------------------------------------------------------
+# the pipeline
+# ---------------------------------------------------------------------------
+
+
+def _record_source(files, num_threads, capacity, shuffle_buf, seed, epochs):
+    """Yield raw records from the C++ threaded loader, falling back to a
+    python round-robin scan of the shards."""
+    from ..native import lib as native_lib
+
+    if native_lib() is not None:
+        from ..native import NativeLoader
+
+        loader = NativeLoader(files, num_threads=num_threads,
+                              capacity=capacity, shuffle_buf=shuffle_buf,
+                              seed=seed, epochs=epochs)
+        try:
+            yield from loader
+        finally:
+            loader.close()
+        return
+    from ..recordio_io import PyReader
+
+    for _ in range(epochs):
+        for f in files:
+            yield from PyReader(f)
+
+
+def image_pipeline(files, mode="train", image_size=224, num_workers=8,
+                   queue_capacity=256, shuffle_buf=1024, seed=0, epochs=1,
+                   color_jitter=False):
+    """Reader creator: recordio shards -> (CHW float32, int64 label).
+
+    A C++ loader thread pool scans/shuffles the shards; ``num_workers``
+    python threads decode+augment concurrently (PIL's codec paths drop the
+    GIL) into a bounded queue, so downstream sees a steady stream of ready
+    tensors.  Per-sample determinism: sample i of epoch e uses
+    ``default_rng((seed, e, i))`` no matter which worker runs it.
+    """
+
+    def reader():
+        import queue as _q
+
+        src_iter = _record_source(files, max(2, num_workers // 2),
+                                  queue_capacity, shuffle_buf if mode == "train" else 0,
+                                  seed, epochs)
+        in_q: _q.Queue = _q.Queue(maxsize=queue_capacity)
+        out_q: _q.Queue = _q.Queue(maxsize=queue_capacity)
+        STOP = object()
+
+        def feed():
+            try:
+                for i, rec in enumerate(src_iter):
+                    in_q.put((i, rec))
+            finally:
+                for _ in range(num_workers):
+                    in_q.put(STOP)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is STOP:
+                    out_q.put(STOP)
+                    return
+                i, rec = item
+                (label,) = struct.unpack_from("<I", rec, 0)
+                gen = np.random.default_rng([seed, i])
+                try:
+                    img = process_image(rec[4:], mode, image_size, gen,
+                                        color_jitter)
+                except Exception:
+                    continue  # corrupt record: skip, as the reference does
+                out_q.put((i, img, np.int64(label)))
+
+        threads = [threading.Thread(target=feed, daemon=True)]
+        threads += [threading.Thread(target=work, daemon=True) for _ in range(num_workers)]
+        for t in threads:
+            t.start()
+        finished = 0
+        while finished < num_workers:
+            item = out_q.get()
+            if item is STOP:
+                finished += 1
+                continue
+            _i, img, label = item
+            yield img, label
+
+    return reader
+
+
+def batched_images(reader_creator, batch_size, drop_last=True):
+    """Batch (img, label) samples into ([B,3,H,W] float32, [B,1] int64)."""
+
+    def batched():
+        imgs, labels = [], []
+        for img, label in reader_creator():
+            imgs.append(img)
+            labels.append(label)
+            if len(imgs) == batch_size:
+                yield np.stack(imgs), np.asarray(labels, np.int64).reshape(-1, 1)
+                imgs, labels = [], []
+        if imgs and not drop_last:
+            yield np.stack(imgs), np.asarray(labels, np.int64).reshape(-1, 1)
+
+    return batched

@@ -258,11 +258,13 @@ def test_beam_search_decoder_decodes():
         ids, scores = exe.run(main, feed=feed, fetch_list=[sent_ids, sent_scores])
         ids2, scores2 = exe.run(main, feed=feed, fetch_list=[sent_ids, sent_scores])
     ids, scores = np.asarray(ids), np.asarray(scores)
-    assert ids.shape[0] == BATCH and ids.shape[1] == BEAM
-    assert scores.shape[:2] == (BATCH, BEAM)
+    # rows are hypotheses (2-level LoD contract): BATCH sources x BEAM lanes
+    assert ids.shape[0] == BATCH * BEAM
+    assert scores.shape[0] == BATCH * BEAM
     assert ids.min() >= 0 and ids.max() < VOCAB
     # the top beam must outscore (or tie) the second per batch row
-    assert np.all(scores[:, 0] >= scores[:, 1] - 1e-6)
+    by_src = scores.reshape(BATCH, BEAM)
+    assert np.all(by_src[:, 0] >= by_src[:, 1] - 1e-6)
     # decode is deterministic under jit
     np.testing.assert_array_equal(ids, np.asarray(ids2))
     np.testing.assert_allclose(scores, np.asarray(scores2), rtol=1e-6)
