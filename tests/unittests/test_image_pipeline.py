@@ -79,3 +79,38 @@ def test_pipeline_decoded_content_matches_source(corpus):
         total += 1
     assert total == 48
     assert hits >= int(0.9 * total), (hits, total)
+
+
+def test_decoded_recordio_pipeline(corpus):
+    """Pre-decoded uint8 recordio path (the thin-host input design: decode
+    once offline, train-time augmentation is slicing)."""
+    from paddle_tpu.reader.image_pipeline import (
+        convert_decoded_to_recordio,
+        decoded_pipeline,
+    )
+
+    samples, _ = corpus
+    import tempfile
+
+    prefix = tempfile.mkdtemp() + "/dec"
+    shards = convert_decoded_to_recordio(samples, prefix, num_shards=2,
+                                         stored_size=48)
+    reader = decoded_pipeline(shards, mode="val", image_size=32, epochs=1,
+                              output="uint8")
+    got = list(reader())
+    assert len(got) == len(samples)
+    assert sorted(int(l) for _, l in got) == sorted(l for _, l in samples)
+    for img, _ in got[:3]:
+        assert img.shape == (3, 32, 32) and img.dtype == np.uint8
+
+    # train mode crops randomly but deterministically per (seed, index)
+    r1 = list(decoded_pipeline(shards, mode="train", image_size=32, seed=7)())
+    r2 = list(decoded_pipeline(shards, mode="train", image_size=32, seed=7)())
+    for (a, la), (b, lb) in zip(r1, r2):
+        np.testing.assert_array_equal(a, b)
+        assert la == lb
+
+    # float32 output is normalized
+    fimg, _ = next(iter(decoded_pipeline(shards, mode="val", image_size=32,
+                                         output="float32")()))
+    assert fimg.dtype == np.float32 and abs(float(fimg.mean())) < 5.0
