@@ -350,21 +350,180 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 # Backward engine switch.  Measured on v5e (fwd+bwd, causal, H=8 D=64,
-# tokens held at 16k): scan 9.9/11.6/14.7/20.8 ms vs pallas
-# 11.1/13.2/18.1/27.6 ms at T=256/512/1024/2048 — XLA fuses the scan's
-# per-block einsums into a single-pass pipeline (p computed once feeds
-# dv/dq/dk), while the two-kernel pallas pair recomputes the score matmuls
-# in each pass (7 matmuls vs 5).  Per SURVEY §6 ("pallas only where XLA
-# fusion is insufficient") scan is the default; the pallas pair stays as a
-# correct, TPU-lowering-tested alternative for shapes where a fused
-# single-read backward may win (very long T with small batch).
-FLASH_BWD_IMPL = "scan"
+# tokens held at 16k): scan 9.9/11.6/14.7/20.8 ms vs the two-kernel pallas
+# pair 11.1/13.2/18.1/27.6 ms at T=256/512/1024/2048 — XLA fuses the
+# scan's per-block einsums into a single-pass pipeline (p computed once
+# feeds dv/dq/dk), while the pair recomputes the score matmuls in each
+# pass (7 matmuls vs 5).  The third engine, "fused", is the
+# dq+dkv-in-ONE-grid kernel: full-T q/o/do/lse stay resident in VMEM, the
+# grid walks key blocks, each step emits that block's dk/dv AND
+# accumulates dq in a VMEM scratch — 5 matmuls and every tensor touches
+# HBM exactly once, but it needs the whole q-side in VMEM so it only
+# applies up to ~T=8k at D=64 (see _fused_bwd_vmem_bytes).  "auto" (the
+# default) picks: fused where it fits AND T >= _FUSED_MIN_T (short T is
+# latency-bound and scan's pipeline wins), scan elsewhere.
+FLASH_BWD_IMPL = "auto"
+_FUSED_MIN_T = 2048
+_FUSED_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom of the 16MB/core
+
+
+def _fused_bwd_vmem_bytes(T, D, in_itemsize, block_k):
+    """Rough VMEM residency of the fused backward: q/o/do tiles (input
+    dtype), lse+delta lanes (f32), the f32 dq accumulator, and the
+    streamed k/v/dk/dv tiles (double-buffered)."""
+    qside = 3 * T * D * in_itemsize      # q, o, do
+    lanes = T * 128 * 4                  # lse+delta, lane-packed f32
+    acc = T * D * 4                      # dq scratch
+    kv = 4 * 2 * block_k * D * in_itemsize
+    return qside + lanes + acc + kv
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
-    if FLASH_BWD_IMPL == "pallas":
+    impl = FLASH_BWD_IMPL
+    if impl == "auto":
+        q = res[0]
+        T, D = q.shape[2], q.shape[3]
+        fits = _fused_bwd_vmem_bytes(T, D, q.dtype.itemsize, min(block_k, k_len(res))) <= _FUSED_VMEM_BUDGET
+        impl = "fused" if (T >= _FUSED_MIN_T and fits) else "scan"
+    if impl == "fused":
+        return _flash_bwd_fused(causal, sm_scale, block_k, interpret, res, do)
+    if impl == "pallas":
         return _flash_bwd_pallas(causal, sm_scale, block_q, block_k, interpret, res, do)
     return _flash_bwd_scan(causal, sm_scale, block_k, res, do)
+
+
+def k_len(res):
+    return res[1].shape[2]
+
+
+def _fused_bwd_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, ld_ref,
+                      dq_ref, dk_ref, dv_ref, dq_scr, *, sm_scale, causal,
+                      block_k, num_k_blocks, q_len, kv_len):
+    """One grid step = one key block against the ENTIRE query side.
+
+    q/do/lse/delta blocks are grid-invariant on the key axis (index map
+    pins them), so Mosaic keeps them resident in VMEM across the walk; dq
+    accumulates in scratch and ships once at the last key block."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    kvl = lens_ref[b]
+    visible = ki * block_k < kvl
+    if causal:
+        visible = jnp.logical_and(
+            visible, ki * block_k <= q_len - 1 + (kv_len - q_len))
+
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)     # [T, D]
+        k = k_ref[0].astype(jnp.float32)     # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)   # [T, D]
+        lse = ld_ref[0][:, 0:1]              # [T, 1]
+        delta = ld_ref[0][:, 1:2]            # [T, 1]
+
+        kcol = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+        k = jnp.where(kcol < kvl, k, 0.0)
+        v = jnp.where(kcol < kvl, v, 0.0)
+
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [T, bk]
+        row = jax.lax.broadcasted_iota(jnp.int32, (q_len, block_k), 0)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (q_len, block_k), 1)
+        ok = col < kvl
+        if causal:
+            ok = ok & (row + (kv_len - q_len) >= col)
+        p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+
+        dv_blk = jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = jnp.where(ok, p * (dp - delta) * sm_scale, 0.0)
+        dk_blk = jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dq_scr[:, :] = dq_scr[:, :] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+        dk_ref[0] = dk_blk.astype(dk_ref.dtype)
+        dv_ref[0] = dv_blk.astype(dv_ref.dtype)
+
+    # invisible blocks still own their dk/dv output tile: zero it
+    @pl.when(jnp.logical_not(visible))
+    def _zero():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:, :].astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused(causal, sm_scale, block_k, interpret, res, do):
+    """dq + dk + dv in ONE Pallas grid (see FLASH_BWD_IMPL)."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, kv_lens, out, lse = res
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    bk = min(block_k, S)
+    nk = -(-S // bk)
+    bh = B * H
+
+    qr = q.reshape(bh, T, D)
+    kr = k.reshape(bh, S, D)
+    vr = v.reshape(bh, S, D)
+    dor = do.reshape(bh, T, D)
+    # lane-packed per-row stats: lane 0 = lse, lane 1 = delta = sum(do*o)
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)  # [B,H,T]
+    ld = jnp.concatenate(
+        [lse.reshape(bh, T, 1), delta.reshape(bh, T, 1)], axis=-1)
+    ld = jnp.pad(ld, ((0, 0), (0, 0), (0, 126)))
+    if kv_lens is None:
+        lens_bh = jnp.full((bh,), S, jnp.int32)
+    else:
+        lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), H)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _fused_bwd_kernel, sm_scale=sm_scale, causal=causal, block_k=bk,
+            num_k_blocks=nk, q_len=T, kv_len=S),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nk),
+            in_specs=[
+                pl.BlockSpec((1, T, D), lambda b, i, lens: (b, 0, 0)),    # q
+                pl.BlockSpec((1, bk, D), lambda b, i, lens: (b, i, 0)),   # k
+                pl.BlockSpec((1, bk, D), lambda b, i, lens: (b, i, 0)),   # v
+                pl.BlockSpec((1, T, D), lambda b, i, lens: (b, 0, 0)),    # do
+                pl.BlockSpec((1, T, 128), lambda b, i, lens: (b, 0, 0)),  # lse+delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, T, D), lambda b, i, lens: (b, 0, 0)),    # dq
+                pl.BlockSpec((1, bk, D), lambda b, i, lens: (b, i, 0)),   # dk
+                pl.BlockSpec((1, bk, D), lambda b, i, lens: (b, i, 0)),   # dv
+            ],
+            scratch_shapes=[pltpu.VMEM((T, D), jnp.float32)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, T, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, S, D), k.dtype),
+            jax.ShapeDtypeStruct((bh, S, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lens_bh, qr, kr, vr, dor, ld)
+    return (
+        dq.reshape(B, H, T, D),
+        dk.reshape(B, H, S, D),
+        dv.reshape(B, H, S, D),
+    )
 
 
 def _flash_bwd_pallas(causal, sm_scale, block_q, block_k, interpret, res, do):

@@ -29,7 +29,7 @@ def test_flash_matches_reference(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("bwd_impl", ["scan", "pallas"])
+@pytest.mark.parametrize("bwd_impl", ["scan", "pallas", "fused"])
 def test_flash_grads_match(causal, bwd_impl, monkeypatch):
     monkeypatch.setattr(FA, "FLASH_BWD_IMPL", bwd_impl)
     q, k, v = _rand_qkv(T=32, D=8, seed=1)
@@ -91,6 +91,33 @@ def test_flash_lowers_for_tpu(causal, with_lens, monkeypatch):
         jax.jit(jax.grad(g, argnums=(0, 1, 2))), platforms=["tpu"])(q, q, q)
     # forward + 2 backward pallas_calls
     assert exported_bwd.mlir_module().count("tpu_custom_call") >= 3
+
+    # the fused one-grid backward (dq+dkv in a single kernel) lowers too
+    monkeypatch.setattr(FA, "FLASH_BWD_IMPL", "fused")
+    exported_fused = jax.export.export(
+        jax.jit(jax.grad(g, argnums=(0, 1, 2))), platforms=["tpu"])(q, q, q)
+    # forward + 1 backward pallas_call
+    assert exported_fused.mlir_module().count("tpu_custom_call") >= 2
+
+
+def test_flash_fused_bwd_kv_lens_and_cross_length(monkeypatch):
+    """Fused one-grid backward under key padding masks and T != S."""
+    monkeypatch.setattr(FA, "FLASH_BWD_IMPL", "fused")
+    B, H, T, S, D = 2, 2, 24, 40, 8
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+    lens = jnp.array([17, 40], jnp.int32)
+
+    gf = jax.grad(lambda a, b, c: (
+        flash_attention(a, b, c, lens, True, None, 16, 16, True) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: (
+        mha_reference(a, b, c, causal=True, kv_lens=lens) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3)
 
 
 def test_flash_uneven_tail_block():
