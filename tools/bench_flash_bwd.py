@@ -1,0 +1,74 @@
+"""Flash backward engine shootout on the real chip (VERDICT r4 item 6).
+
+Times fwd+bwd for scan vs the fused one-grid Pallas backward (and the
+two-kernel pair) at long sequence lengths, tokens held constant.  Run on
+a healthy TPU:  python tools/bench_flash_bwd.py
+Prints a markdown table for PERF.md.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import flash_attention as FA
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    print("devices:", jax.devices(), "on_tpu:", on_tpu)
+
+    H, D = 8, 64
+    tokens = 16384 if on_tpu else 512
+    rows = []
+    for T in ((2048, 4096, 8192) if on_tpu else (128, 256)):
+        B = max(1, tokens // T)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        dt = jnp.bfloat16 if on_tpu else jnp.float32
+        q = jax.random.normal(ks[0], (B, H, T, D), dt)
+        k = jax.random.normal(ks[1], (B, H, T, D), dt)
+        v = jax.random.normal(ks[2], (B, H, T, D), dt)
+
+        times = {}
+        for impl in ("scan", "fused", "pallas"):
+            FA.FLASH_BWD_IMPL = impl
+
+            def loss(q, k, v):
+                o = FA.flash_attention(q, k, v, None, True, None, 128, 128,
+                                       None if on_tpu else True)
+                return (o.astype(jnp.float32) ** 2).sum()
+
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                out = g(q, k, v)  # compile + warmup
+                np.asarray(out[0][0, 0, 0])
+                iters = 10 if on_tpu else 2
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = g(q, k, v)
+                np.asarray(out[0][0, 0, 0])  # sync via readback (tunnel-safe)
+                times[impl] = (time.perf_counter() - t0) / iters * 1e3
+            except Exception as e:  # noqa: BLE001
+                times[impl] = float("nan")
+                print("  %s T=%d failed: %s" % (impl, T, e), file=sys.stderr)
+        rows.append((T, B, times))
+        print("T=%d B=%d: %s" % (T, B, {k_: round(v_, 2) for k_, v_ in times.items()}))
+
+    print("\n| T | B | scan ms | fused ms | pair ms | winner |")
+    print("|---|---|---|---|---|---|")
+    for T, B, t in rows:
+        best = min((v, k_) for k_, v in t.items() if v == v)[1]
+        print("| %d | %d | %.2f | %.2f | %.2f | %s |"
+              % (T, B, t.get("scan", float("nan")), t.get("fused", float("nan")),
+                 t.get("pallas", float("nan")), best))
+
+
+if __name__ == "__main__":
+    main()
