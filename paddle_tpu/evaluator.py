@@ -11,7 +11,7 @@ from .framework import Program, Variable, program_guard
 from .layer_helper import LayerHelper
 from .initializer import Constant
 
-__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
 
 
 def _clone_var_(block, var):
@@ -45,14 +45,14 @@ class Evaluator:
     def eval(self, executor, eval_program=None):
         raise NotImplementedError()
 
-    def _create_state(self, suffix, dtype, shape):
+    def _create_state(self, suffix, dtype, shape, init_value=0.0):
         state = self.helper.create_global_variable(
             name="_".join([self.helper.name, str(suffix)]),
             persistable=True,
             dtype=dtype,
             shape=shape,
         )
-        self.helper.set_variable_initializer(state, Constant(0.0))
+        self.helper.set_variable_initializer(state, Constant(init_value))
         self.states.append(state)
         return state
 
@@ -117,3 +117,84 @@ class EditDistance(Evaluator):
         total = float(np.asarray(scope[self.total_distance.name]).reshape(-1)[0])
         n = float(np.asarray(scope[self.seq_num.name]).reshape(-1)[0])
         return np.array([total / n if n else 0.0])
+
+
+class DetectionMAP(Evaluator):
+    """Accumulative detection mAP evaluator (reference evaluator.py:298).
+
+    Builds two in-graph ``layers.detection_map`` ops: a stateless one for
+    the current-minibatch mAP and a state-fed one whose accumulator
+    outputs write back into this evaluator's persistable state vars, so
+    every ``Executor.run`` of the training/eval program pools TP/FP/gt
+    counts across batches.  Padded-contract inputs (see
+    layers/detection.py detection_map): ``input`` [B, K, 6],
+    ``gt_box`` [B, G, 4], ``gt_label`` [B, G] (+ lengths via LoDArray).
+    ``gt_difficult`` rows are EXCLUDED from the gt count when
+    ``evaluate_difficult=False`` by masking their label to background.
+    """
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 state_capacity=512):
+        super().__init__("map_eval")
+        from .layers import detection as det_layers
+        from .layers import nn, tensor as tl
+
+        if class_num is None:
+            raise ValueError("DetectionMAP needs class_num")
+        label = gt_label
+        if gt_difficult is not None and not evaluate_difficult:
+            # difficult gt must count neither as positives nor toward npos:
+            # folding them into the background class removes both.
+            # label' = label*(1-diff) + background*diff, diff in {0, 1}
+            diff = tl.cast(gt_difficult, "float32")
+            if len(diff.shape) == 3:
+                diff = nn.squeeze(diff, axes=[2])
+            keep = nn.scale(diff, scale=-1.0, bias=1.0)
+            label = tl.cast(
+                nn.elementwise_add(
+                    x=nn.elementwise_mul(x=tl.cast(label, "float32"), y=keep),
+                    y=nn.scale(diff, scale=float(background_label))),
+                "int64")
+
+        # current-minibatch mAP (stateless)
+        self.cur_map, _, _, _ = det_layers.detection_map(
+            input, gt_box, label, class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            ap_version=ap_version, state_capacity=state_capacity)
+
+        # accumulative mAP: accumulator outputs ARE the persistable states
+        pc = self._create_state(dtype="int32", shape=[class_num, 1],
+                                suffix="accum_pos_count")
+        # -1 marks an empty TP/FP score slot (see ops/detection_ops.py)
+        tp = self._create_state(dtype="float32", shape=[class_num, state_capacity, 2],
+                                suffix="accum_true_pos", init_value=-1.0)
+        fp = self._create_state(dtype="float32", shape=[class_num, state_capacity, 2],
+                                suffix="accum_false_pos", init_value=-1.0)
+        accum_map, pc_out, tp_out, fp_out = det_layers.detection_map(
+            input, gt_box, label, class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            input_states=(pc, tp, fp),
+            ap_version=ap_version, state_capacity=state_capacity)
+        tl.assign(pc_out, output=pc)
+        tl.assign(tp_out, output=tp)
+        tl.assign(fp_out, output=fp)
+        self.accum_map = accum_map
+        self.metrics.extend([self.cur_map, accum_map])
+
+    def get_map_var(self):
+        """(current-batch mAP var, accumulative mAP var) — fetch both."""
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None):
+        """Empty the pooled TP/FP state (score slots use -1 as 'empty')."""
+        scope = global_scope()
+        for var in self.states:
+            shape = [d if d > 0 else 1 for d in (var.shape or [1])]
+            if var.name.endswith("pos_count"):
+                scope[var.name] = np.zeros(shape, "int32")
+            else:
+                scope[var.name] = np.full(shape, -1.0, "float32")

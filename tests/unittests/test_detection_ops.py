@@ -216,3 +216,56 @@ def test_detection_map_pools_tp_fp_across_batches():
     np.testing.assert_allclose(pooled, 2.0 / 3.0, rtol=1e-6)
     assert abs(per_batch_avg - 0.75) < 1e-6  # what the buggy average would say
     assert abs(pooled - per_batch_avg) > 0.05
+
+
+def test_detection_map_evaluator_accumulates_across_batches():
+    """fluid.evaluator.DetectionMAP (reference evaluator.py:298): the
+    state-fed accumulative mAP pooled over two Executor.run batches equals
+    the host metric over the combined detections; reset() empties it."""
+    import paddle_tpu as fluid
+    from paddle_tpu import metrics
+    from paddle_tpu.evaluator import DetectionMAP
+    from paddle_tpu.lod import LoDArray
+
+    K = 3
+    pad = [[-1, 0, 0, 0, 0, 0]]
+    det1 = np.array([[[1, 0.9, 0, 0, 1, 1]] + pad * (K - 1)], "float32")
+    gtb1 = np.array([[[0, 0, 1, 1]]], "float32")
+    gtl1 = np.array([[1]], "int64")
+    det2 = np.array([[[1, 0.6, 5, 5, 6, 6]] + pad * (K - 1)], "float32")
+    gtb2 = np.array([[[4, 4, 5, 5]]], "float32")
+    gtl2 = np.array([[1]], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = fluid.layers.data(name="d", shape=[K, 6], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[-1, 4], dtype="float32", lod_level=1)
+        l = fluid.layers.data(name="l", shape=[-1], dtype="int64")
+        ev = DetectionMAP(d, l, b, class_num=2, overlap_threshold=0.5)
+        cur_map, accum_map = ev.get_map_var()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        feeds = [
+            {"d": det1, "b": LoDArray(gtb1, np.array([1], "int64")), "l": gtl1},
+            {"d": det2, "b": LoDArray(gtb2, np.array([1], "int64")), "l": gtl2},
+        ]
+        accums = []
+        for f in feeds:
+            _, am = exe.run(main, feed=f, fetch_list=[cur_map, accum_map])
+            accums.append(float(np.ravel(am)[0]))
+
+        # pooled result after batch 2 == host metric on the union
+        det_all = np.concatenate([det1, det2], axis=0)
+        gtb_all = np.concatenate([gtb1, gtb2], axis=0)
+        gtl_all = np.concatenate([gtl1, gtl2], axis=0)
+        want = metrics.compute_detection_map(
+            det_all, gtb_all, gtl_all, np.array([1, 1], "int64"),
+            num_classes=2, overlap_threshold=0.5)
+        np.testing.assert_allclose(accums[-1], want, rtol=1e-5)
+
+        # reset empties the pooled state: next accum equals a fresh batch-1 run
+        ev.reset(exe)
+        _, am = exe.run(main, feed=feeds[0], fetch_list=[cur_map, accum_map])
+        np.testing.assert_allclose(float(np.ravel(am)[0]), accums[0], rtol=1e-5)
