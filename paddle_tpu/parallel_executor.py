@@ -25,7 +25,7 @@ import numpy as np
 from .executor import Executor, global_scope
 from .framework import default_main_program, Variable
 
-__all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
+__all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy", "build_mesh"]
 
 
 class ExecutionStrategy:
@@ -62,6 +62,29 @@ class BuildStrategy:
         self.sharding_rules = None
 
 
+def build_mesh(mesh_shape=None, devices=None):
+    """(dp, tp[, sp]) tuple / {axis: size} dict / None -> jax Mesh.
+    None or True means a 1-D data-parallel mesh over all devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    if not mesh_shape or mesh_shape is True:
+        return Mesh(np.array(devs), ("dp",))
+    if isinstance(mesh_shape, dict):
+        names = tuple(mesh_shape)
+        sizes = tuple(int(mesh_shape[n]) for n in names)
+    else:
+        sizes = tuple(int(s) for s in mesh_shape)
+        names = ("dp", "tp", "sp")[: len(sizes)]
+    need = int(np.prod(sizes))
+    if need > len(devs):
+        raise ValueError(
+            "mesh_shape %r needs %d devices, only %d available"
+            % (mesh_shape, need, len(devs)))
+    return Mesh(np.array(devs[:need]).reshape(sizes), names)
+
+
 class ParallelExecutor:
     def __init__(
         self,
@@ -80,7 +103,6 @@ class ParallelExecutor:
         sharding_rules=None,
     ):
         import jax
-        from jax.sharding import Mesh
 
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
@@ -90,21 +112,7 @@ class ParallelExecutor:
             mesh_shape = getattr(build_strategy, "mesh_shape", None)
         if sharding_rules is None and build_strategy is not None:
             sharding_rules = getattr(build_strategy, "sharding_rules", None)
-        if mesh_shape:
-            if isinstance(mesh_shape, dict):
-                names = tuple(mesh_shape)
-                sizes = tuple(int(mesh_shape[n]) for n in names)
-            else:
-                sizes = tuple(int(s) for s in mesh_shape)
-                names = ("dp", "tp", "sp")[: len(sizes)]
-            need = int(np.prod(sizes))
-            if need > len(devs):
-                raise ValueError(
-                    "mesh_shape %r needs %d devices, only %d available"
-                    % (mesh_shape, need, len(devs)))
-            self._mesh = Mesh(np.array(devs[:need]).reshape(sizes), names)
-        else:
-            self._mesh = Mesh(np.array(devs), ("dp",))
+        self._mesh = build_mesh(mesh_shape, devs)
         self._exe = Executor()
         self._exe._mesh = self._mesh
         self._exe._sharding_rules = sharding_rules

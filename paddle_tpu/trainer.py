@@ -115,7 +115,12 @@ class Trainer:
     Optimizer.  Runs the loop, fires events, checkpoints, resumes."""
 
     def __init__(self, train_func, optimizer_func, param_path=None, place=None,
-                 parallel=False, checkpoint_config=None):
+                 parallel=False, checkpoint_config=None, sharding_rules=None):
+        """``parallel``: False = single device; True = data-parallel over
+        every device (the reference's ParallelExecutor-under-Trainer mode);
+        a ``(dp, tp[, sp])`` tuple or ``{axis: size}`` dict = multi-axis
+        mesh with Megatron tp shardings (parallel_executor.build_mesh),
+        refined by ``sharding_rules``."""
         from .core import TPUPlace
 
         self.place = place if place is not None else TPUPlace()
@@ -139,6 +144,11 @@ class Trainer:
 
         self.test_program = self.train_program.clone(for_test=True)
         self.exe = Executor(self.place)
+        if parallel:
+            from .parallel_executor import build_mesh
+
+            self.exe._mesh = build_mesh(parallel)
+            self.exe._sharding_rules = sharding_rules
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
             if param_path:

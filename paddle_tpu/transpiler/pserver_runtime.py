@@ -127,7 +127,18 @@ class _SyncRound:
 def serve(executor, program, scope):
     """Run a pserver program (a single listen_and_serv op).  Blocks until a
     trainer sends shutdown.  Reference: Executor runs listen_and_serv_op
-    which blocks serving RPC."""
+    which blocks serving RPC.
+
+    Fault tolerance (reference analog: go/pserver checkpointing + etcd
+    registration, go/pserver/client/etcd_client.go): a ``checkpoint_dir``
+    attr makes the server (a) RESTORE its parameter shards from the
+    newest snapshot before serving — a restarted pserver resumes with the
+    learned state — and (b) atomically snapshot after every sync round.
+    With ``PADDLE_REGISTRY`` set (or a ``registry`` attr), the endpoint
+    registers under ``pservers/<endpoint>`` with a liveness lease
+    (transpiler/discovery.py) so trainers discover/re-resolve it."""
+    import os as _os
+
     ls = program.global_block().ops[-1]
     assert ls.type == "listen_and_serv"
     endpoint = ls.attrs["endpoint"]
@@ -135,6 +146,37 @@ def serve(executor, program, scope):
     grad_names = list(ls.attrs["grad_names"])
     param_names = list(ls.attrs["param_names"])
     opt_block = ls.sub_block
+    ckpt_dir = ls.attrs.get("checkpoint_dir")
+
+    if ckpt_dir:
+        path = _os.path.join(ckpt_dir, "pserver_params.npz")
+        if _os.path.exists(path):
+            loaded = np.load(path)
+            for name in loaded.files:
+                scope.vars[name] = loaded[name]
+
+    def _save_checkpoint():
+        if not ckpt_dir:
+            return
+        _os.makedirs(ckpt_dir, exist_ok=True)
+        path = _os.path.join(ckpt_dir, "pserver_params.npz")
+        tmp = path + ".tmp.npz"
+        arrays = {p: np.asarray(scope.vars[p]) for p in param_names
+                  if scope.vars.get(p) is not None}
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        _os.replace(tmp, path)
+
+    registry_client = None
+    registry_ep = ls.attrs.get("registry") or _os.environ.get("PADDLE_REGISTRY")
+    if registry_ep:
+        from .discovery import RegistryClient
+
+        try:
+            registry_client = RegistryClient(registry_ep)
+            registry_client.register("pservers/" + endpoint, endpoint, ttl=5.0)
+        except (OSError, ValueError):
+            registry_client = None  # registry down: serve anyway
 
     # one-block program that applies the optimizer ops given grad feeds
     from ..framework import Program
@@ -149,6 +191,7 @@ def serve(executor, program, scope):
 
     def apply_fn(summed_grads):
         executor.run(apply_prog, feed=dict(summed_grads), fetch_list=[], scope=scope)
+        _save_checkpoint()
 
     round_ = _SyncRound(fanin)
     stop = threading.Event()
@@ -198,6 +241,12 @@ def serve(executor, program, scope):
         t.start()
         threads.append(t)
     srv.close()
+    if registry_client is not None:
+        try:
+            registry_client.unregister("pservers/" + endpoint)
+            registry_client.close()
+        except (OSError, IOError):
+            pass
     for t in threads:
         t.join(timeout=5)
     return []
@@ -241,7 +290,22 @@ def run_trainer_step(executor, program, feed, fetch_list, scope, clients):
             by_ep.setdefault(ep, {})[sname] = part
     fresh_all = {}
     for ep, grads in by_ep.items():
-        fresh_all.update(clients[ep].push_pull(grads))
+        # fault tolerance: a pserver restart drops the TCP connection; the
+        # round is idempotent server-side (grads not yet applied on a torn
+        # round: the barrier never completed), so reconnect — PSClient's
+        # constructor waits for the endpoint to come back — and resend.
+        for attempt in range(3):
+            try:
+                fresh_all.update(clients[ep].push_pull(grads))
+                break
+            except (IOError, OSError):
+                if attempt == 2:
+                    raise
+                try:
+                    clients[ep].close()
+                except Exception:  # noqa: BLE001
+                    pass
+                clients[ep] = PSClient(ep)
     # reassemble sliced params row-wise; whole params pass through
     param_slices = recv_op.attrs.get("slices") or {}
     for pname in recv_op.outputs["Out"]:

@@ -1,0 +1,221 @@
+"""Discovery registry (transpiler/discovery.py — the etcd analog:
+reference go/master/etcd_client.go, go/pserver/client/etcd_client.go) and
+pserver fault tolerance: checkpointed restart recovery + trainer
+reconnect."""
+import os
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler.discovery import RegistryClient, RegistryServer
+
+
+def test_registry_register_lookup_lease_expiry(tmp_path):
+    srv = RegistryServer(snapshot_path=str(tmp_path / "reg.snap"))
+    try:
+        c = RegistryClient(srv.endpoint)
+        # leased key WITHOUT keepalive dies after its ttl (liveness)
+        c.register("pservers/a", "127.0.0.1:1", ttl=0.4, keepalive=False)
+        # keepalive'd key stays alive past its ttl
+        c.register("pservers/b", "127.0.0.1:2", ttl=0.4, keepalive=True)
+        # permanent key (no lease)
+        c.register("config/trainers", 2, ttl=None, keepalive=False)
+        assert set(c.lookup("pservers/")) == {"pservers/a", "pservers/b"}
+        time.sleep(1.2)
+        live = c.lookup("pservers/")
+        assert "pservers/a" not in live  # lease expired
+        assert "pservers/b" in live      # renewed
+        assert c.lookup("config/") == {"config/trainers": 2}
+        c.unregister("pservers/b")
+        assert c.lookup("pservers/") == {}
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_registry_wait_for_barrier():
+    srv = RegistryServer()
+    try:
+        c = RegistryClient(srv.endpoint)
+
+        def late_register():
+            time.sleep(0.3)
+            c2 = RegistryClient(srv.endpoint)
+            c2.register("ps/1", "e1", ttl=None, keepalive=False)
+
+        threading.Thread(target=late_register, daemon=True).start()
+        c.register("ps/0", "e0", ttl=None, keepalive=False)
+        got = c.wait_for("ps/", 2, timeout=5.0)
+        assert set(got.values()) == {"e0", "e1"}
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_registry_snapshot_survives_restart(tmp_path):
+    snap = str(tmp_path / "reg.snap")
+    srv = RegistryServer(snapshot_path=snap)
+    c = RegistryClient(srv.endpoint)
+    c.register("config/x", {"dim": 4}, ttl=None, keepalive=False)
+    c.close()
+    srv.close()
+    time.sleep(0.1)
+
+    # fresh ephemeral port: the persistence contract is the SNAPSHOT, not
+    # the port (rebinding the same port races TIME_WAIT on some kernels)
+    srv2 = RegistryServer(snapshot_path=snap)
+    try:
+        c2 = RegistryClient(srv2.endpoint)
+        assert c2.lookup("config/") == {"config/x": {"dim": 4}}
+        c2.close()
+    finally:
+        srv2.close()
+
+
+def _build_program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, cost
+
+
+def test_pserver_kill_and_resume_with_checkpoint(tmp_path):
+    """The dense pserver restarts mid-training and resumes from its sync-
+    round checkpoint; the trainer reconnects transparently and the final
+    weights reach the optimum (reference analog: pserver recovery from
+    etcd-coordinated checkpoints)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        ep = "127.0.0.1:%d" % s.getsockname()[1]
+
+    main, startup, cost = _build_program()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=ep, trainers=1)
+    trainer_prog = t.get_trainer_program()
+    pserver_prog = t.get_pserver_program(ep)
+    pserver_startup = t.get_startup_program(ep, pserver_prog, startup)
+    ls = pserver_prog.global_block().ops[-1]
+    ls.attrs["checkpoint_dir"] = str(tmp_path)
+
+    def serve_once(run_startup):
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        def run():
+            with fluid.scope_guard(scope):
+                if run_startup:
+                    exe.run(pserver_startup, scope=scope)
+                else:
+                    # crash-restart: params come from the checkpoint, but
+                    # non-param state (lr schedules etc.) still needs init
+                    exe.run(pserver_startup, scope=scope)
+                exe.run(pserver_prog, scope=scope)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        return th
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype("float32")
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], "float32")
+    Y = X @ w_true + 0.1
+
+    th1 = serve_once(run_startup=True)
+    time.sleep(0.5)
+
+    tr_scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(tr_scope):
+        exe.run(startup, scope=tr_scope)
+        for _ in range(20):
+            (lv,) = exe.run(trainer_prog, feed={"x": X, "y": Y},
+                            fetch_list=[cost], scope=tr_scope)
+            losses.append(float(np.ravel(lv)[0]))
+
+        # "crash" the pserver: close its executor's serving loop abruptly
+        # by sending shutdown (state save already happened per round), then
+        # restart from the checkpoint dir on the same endpoint
+        exe.close()
+        th1.join(timeout=10)
+        assert not th1.is_alive()
+        assert os.path.exists(os.path.join(str(tmp_path), "pserver_params.npz"))
+
+        th2 = serve_once(run_startup=False)
+        time.sleep(0.5)
+        for _ in range(40):
+            (lv,) = exe.run(trainer_prog, feed={"x": X, "y": Y},
+                            fetch_list=[cost], scope=tr_scope)
+            losses.append(float(np.ravel(lv)[0]))
+        w_final = np.asarray(tr_scope.vars["w"])
+        exe.close()
+        th2.join(timeout=10)
+
+    # loss after resume continues from the checkpointed state: the first
+    # post-restart loss must be well below the cold-start loss
+    assert losses[20] < 0.5 * losses[0], (losses[0], losses[20])
+    assert losses[-1] < 0.05 * losses[0]
+    np.testing.assert_allclose(w_final, w_true, atol=0.3)
+
+
+def test_pserver_registers_in_registry(tmp_path):
+    """listen_and_serv with PADDLE_REGISTRY registers its endpoint under a
+    liveness lease and removes it on shutdown."""
+    import socket
+
+    srv = RegistryServer()
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            ep = "127.0.0.1:%d" % s.getsockname()[1]
+
+        main, startup, cost = _build_program()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers=ep, trainers=1)
+        pserver_prog = t.get_pserver_program(ep)
+        pserver_startup = t.get_startup_program(ep, pserver_prog, startup)
+        pserver_prog.global_block().ops[-1].attrs["registry"] = srv.endpoint
+
+        scope = fluid.Scope()
+        ps_exe = fluid.Executor(fluid.CPUPlace())
+
+        def run():
+            with fluid.scope_guard(scope):
+                ps_exe.run(pserver_startup, scope=scope)
+                ps_exe.run(pserver_prog, scope=scope)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+
+        c = RegistryClient(srv.endpoint)
+        got = c.wait_for("pservers/", 1, timeout=10.0)
+        assert got == {"pservers/" + ep: ep}
+
+        # trainer-side discovery instead of a static epmap
+        exe = fluid.Executor(fluid.CPUPlace())
+        trainer_prog = t.get_trainer_program()
+        rng = np.random.RandomState(1)
+        X = rng.randn(16, 4).astype("float32")
+        Y = X @ np.ones((4, 1), "float32")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(trainer_prog, feed={"x": X, "y": Y}, fetch_list=[cost])
+        exe.close()
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert c.lookup("pservers/") == {}  # unregistered on shutdown
+        c.close()
+    finally:
+        srv.close()

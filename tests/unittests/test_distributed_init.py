@@ -36,9 +36,9 @@ def test_single_process_lifecycle():
     port = _free_port()
     code = (
         "from paddle_tpu.parallel import collective as C\n"
-        "C.init_distributed('localhost:%d', 1, 0)\n"
-        "C.init_distributed('localhost:%d', 1, 0)  # repeat: no-op\n" % (port, port)
-        "import jax; assert jax.process_count() == 1\n"
+        "C.init_distributed('localhost:%d', 1, 0)\n" % port
+        + "C.init_distributed('localhost:%d', 1, 0)  # repeat: no-op\n" % port
+        + "import jax; assert jax.process_count() == 1\n"
         "C.shutdown_distributed()\n"
         "C.shutdown_distributed()\n"
         "print('LIFECYCLE-OK')\n"
@@ -60,7 +60,7 @@ def test_two_process_psum_over_localhost():
         "import numpy as np\n"
         "from paddle_tpu.parallel import collective as C\n"
         "C.init_distributed('localhost:%d', 2, int(sys.argv[1]))\n" % port
-        "import jax, jax.numpy as jnp\n"
+        + "import jax, jax.numpy as jnp\n"
         "from jax.sharding import Mesh, PartitionSpec as P\n"
         "from jax import shard_map\n"
         "assert jax.process_count() == 2\n"

@@ -120,3 +120,29 @@ def test_trainer_mid_epoch_resume_skips_applied_steps(tmp_path):
             executed.append((e.epoch, e.step))
     t2.train(num_epochs=1, event_handler=record, reader=_reader, feed_order=["x", "y"])
     assert executed == [(0, s) for s in range(3, 8)], executed
+
+
+def test_trainer_parallel_mesh_matches_single_device():
+    """Trainer(parallel=(4, 2)) trains over a dp4xtp2 mesh with Megatron
+    param shardings and reproduces single-device numerics."""
+
+    def run(parallel):
+        losses = []
+
+        def handler(e):
+            if isinstance(e, fluid.EndStepEvent):
+                losses.append(float(np.ravel(e.metrics[0])[0]))
+
+        np.random.seed(123)  # pins the startup RNG draw for both runs
+        t = fluid.Trainer(_train_func, _optimizer_func,
+                          place=fluid.CPUPlace(), parallel=parallel)
+        t.train(num_epochs=2, event_handler=handler, reader=_reader,
+                feed_order=["x", "y"])
+        with fluid.scope_guard(t.scope):
+            w = np.asarray(fluid.global_scope()["w"]).copy()
+        return losses, w
+
+    single_losses, w_single = run(parallel=False)
+    mesh_losses, w_mesh = run(parallel=(4, 2))
+    np.testing.assert_allclose(mesh_losses, single_losses, rtol=1e-4)
+    np.testing.assert_allclose(w_mesh, w_single, rtol=1e-4, atol=1e-6)
