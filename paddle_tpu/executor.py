@@ -427,6 +427,81 @@ def interpret_ops(ctx: LoweringContext, ops):
                         )
 
 
+_COMPANION_SUFFIXES = ("@LENGTHS", "@SUBLENGTHS", "@ARRAY", "@ARRAYLEN")
+
+
+def _ops_read_names(ops):
+    """Every env name an op list may read: declared inputs (recursing into
+    control-flow sub-blocks, whose bodies read outer names not listed on
+    the parent op) plus the ragged/array companion spellings."""
+    names = set()
+
+    def walk(op):
+        for ns in op.inputs.values():
+            names.update(ns)
+        # sub-block bodies close over outer env names
+        sub = getattr(op, "sub_block", None)
+        if sub is not None:
+            for o in sub.ops:
+                walk(o)
+        for blk_attr in ("sub_block_2", "else_block"):
+            sub2 = getattr(op, blk_attr, None)
+            if sub2 is not None:
+                for o in sub2.ops:
+                    walk(o)
+
+    for op in ops:
+        walk(op)
+    out = set(names)
+    for n in names:
+        for suf in _COMPANION_SUFFIXES:
+            out.add(n + suf)
+    return out
+
+
+def _run_recompute_segments(ctx, env0, pre, n_segments, keep):
+    """Forward prefix as ``n_segments`` jax.checkpoint segments
+    (Program.enable_recompute).  Each segment's boundary env is pruned to
+    the names later segments / the keep-set can read, so the residuals
+    jax.checkpoint stores shrink from every activation to the segment
+    boundaries; interiors are recomputed during the backward sweep.
+
+    Safe under retracing: op RNG is positional (LoweringContext.op_key),
+    so the recompute replay draws identical randomness."""
+    import jax
+
+    # keep companions of kept names too (fetch reconstruction reads them)
+    keep = set(keep)
+    for n in list(keep):
+        for suf in _COMPANION_SUFFIXES:
+            keep.add(n + suf)
+
+    bounds = [len(pre) * i // n_segments for i in range(n_segments + 1)]
+    segments = [pre[bounds[i]: bounds[i + 1]] for i in range(n_segments)]
+    segments = [s for s in segments if s]
+
+    # live-after set per segment, computed back-to-front
+    live_after = [None] * len(segments)
+    acc = set(keep)
+    for i in range(len(segments) - 1, -1, -1):
+        live_after[i] = set(acc)
+        acc |= _ops_read_names(segments[i])
+
+    env = env0
+    for i, seg in enumerate(segments):
+        def run_seg(env_in, _seg=seg):
+            c2 = ctx.child(dict(env_in))
+            interpret_ops(c2, _seg)
+            return c2.env
+
+        if i < len(segments) - 1:
+            run_seg = jax.checkpoint(run_seg)
+        env = run_seg(env)
+        live = live_after[i]
+        env = {n: v for n, v in env.items() if n in live}
+    return env
+
+
 def lower_block(ctx: LoweringContext, block: Block):
     """Trace a block, handling the single ``backward`` meta-op if present.
 
@@ -473,12 +548,24 @@ def lower_block(ctx: LoweringContext, block: Block):
     outer_env = ctx.env
     wrt_set = set(wrt_names)
 
+    n_segments = int(getattr(ctx.program, "_recompute_segments", 0) or 0)
+
     def fwd(wrt_vals):
         env2 = dict(outer_env)
         env2.update(wrt_vals)
         c2 = ctx.child(env2)
         if bop.type == "backward":
-            interpret_ops(c2, pre)
+            if n_segments > 1 and len(pre) >= n_segments:
+                env3 = _run_recompute_segments(
+                    ctx, env2, pre, n_segments,
+                    keep=set(target_names) | set(tg_names)
+                    | _ops_read_names(post)
+                    | set(getattr(ctx, "keep_names", ()) or ())
+                    | {v.name for v in ctx.program.list_vars() if v.persistable})
+                env2.clear()
+                env2.update(env3)
+            else:
+                interpret_ops(c2, pre)
         else:
             # calc_gradient may target grads w.r.t. *intermediate* vars: the
             # graph is cut at each wrt name — its producer still runs (for
@@ -621,6 +708,7 @@ class Executor:
             tuple(fetch_names),
             tuple(sorted(state_in)),
             _NAN_DEBUG["on"],  # probes are baked into the executable
+            int(getattr(program, "_recompute_segments", 0) or 0),
         )
         entry = self._cache.get(sig) if use_program_cache else None
         if entry is not None:
@@ -775,6 +863,8 @@ class Executor:
             env.update(state)
             env.update(feeds)
             ctx = LoweringContext(program, env, use_key, mesh=self._mesh)
+            # names the step must surface even under recompute pruning
+            ctx.keep_names = tuple(fetch_names)
             lower_block(ctx, program.global_block())
             fetches = []
             for f in fetch_names:

@@ -386,6 +386,21 @@ class Program:
         self._version = 0
         self._seed = 0
         self.random_seed = 0
+        # activation rematerialization: >1 splits the forward prefix into
+        # that many jax.checkpoint segments (see Program.enable_recompute)
+        self._recompute_segments = 0
+
+    def enable_recompute(self, segments=4):
+        """Trade FLOPs for HBM: the backward pass recomputes activations
+        per segment instead of storing them all (TPU-native analog of
+        gradient checkpointing; no reference API — Fluid v0.15 stored every
+        activation).  The forward prefix is partitioned into ``segments``
+        chunks, each wrapped in ``jax.checkpoint``: peak activation memory
+        drops to ~1/segments of the forward (plus one segment's interior),
+        at the cost of one extra forward pass worth of FLOPs."""
+        self._recompute_segments = int(segments)
+        self._bump()
+        return self
 
     # executor cache invalidation
     def _bump(self):

@@ -26,6 +26,7 @@ def program_to_fn(program: Program, fetch_list, is_test=False, return_state=Fals
         env.update(state)
         env.update(feeds)
         ctx = LoweringContext(program, env, key, is_test=is_test)
+        ctx.keep_names = tuple(fetch_names)
         lower_block(ctx, program.global_block())
         fetches = [env[n] for n in fetch_names]
         if return_state:
