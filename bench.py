@@ -312,10 +312,14 @@ def main():
         traceback.print_exc(file=sys.stderr)
     for kwargs in (
         {},  # Transformer-base headline config (batch 64, seq 256)
-        # long-context config: flash attention's O(T) HBM advantage compounds;
-        # no reference baseline exists for this shape (vs_baseline omitted)
+        # long-context configs: flash attention's O(T) HBM advantage compounds;
+        # no reference baseline exists for these shapes (vs_baseline omitted).
+        # At seq>=2048 the fused one-grid Pallas backward auto-engages
+        # (parallel/flash_attention.py FLASH_BWD_IMPL="auto").
         {"batch": 16, "seq": 1024, "baseline": None,
          "metric": "transformer_seq1024_tokens_per_sec_per_chip", "iters": 15},
+        {"batch": 4, "seq": 4096, "baseline": None,
+         "metric": "transformer_seq4096_tokens_per_sec_per_chip", "iters": 10},
     ):
         if kwargs and not on_tpu:
             continue  # long-seq config is TPU-only (too slow on CPU fallback)
