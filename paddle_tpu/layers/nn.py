@@ -1635,17 +1635,18 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None, name=No
     return out
 
 
-def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=False, name=None):
+def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=True, name=None):
     """Fused flash attention over [batch, heads, time, head_dim] tensors
     (pallas TPU kernel; see parallel/flash_attention.py).  ``kv_lens``
     ([batch] int) applies a key padding mask without building a [T, S]
     bias.  No reference analog — the reference composes matmul+softmax.
 
-    ``sequence_parallel=True`` opts this op into ring attention over the
-    executor mesh's ``sp`` axis (parallel/ring_attention.py) when the
-    program runs under a ``ParallelExecutor`` whose ``mesh_shape`` carries
-    one — the time dimension is block-sharded across devices and K/V blocks
-    rotate over ICI.  Without an sp axis the attr is a no-op."""
+    Under a ``ParallelExecutor`` whose ``mesh_shape`` carries a
+    non-trivial ``sp`` axis, this op runs ring attention
+    (parallel/ring_attention.py): the time dimension is block-sharded
+    across devices and K/V blocks rotate over ICI.  Pass
+    ``sequence_parallel=False`` to force the single-shard kernel; without
+    an sp axis the flag is a no-op."""
     helper = LayerHelper("flash_attention", **locals())
     out = helper.create_variable_for_type_inference(dtype=q.dtype, shape=q.shape)
     inputs = {"Q": [q], "K": [k], "V": [v]}

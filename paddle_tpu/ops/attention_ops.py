@@ -26,10 +26,11 @@ def _flash_attention(ctx, op):
 
     # sequence-parallel ring attention over the executor mesh's 'sp' axis:
     # shard_map blocks T across devices and rotates K/V over ICI (ppermute).
-    # Falls back to the single-shard kernel when there's no sp axis, the
-    # axis is trivial, T doesn't divide, or kv_lens masking is requested
-    # (the ring path assumes dense blocks).
-    if bool(op.attrs.get("sequence_parallel", False)) and ctx.mesh is not None:
+    # Giving the mesh a non-trivial sp axis IS the opt-in (attr
+    # sequence_parallel=False forces the single-shard kernel); falls back
+    # when T doesn't divide or kv_lens masking is requested (the ring path
+    # assumes dense blocks).
+    if bool(op.attrs.get("sequence_parallel", True)) and ctx.mesh is not None:
         mesh = ctx.mesh
         axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         sp = int(axis_sizes.get("sp", 1))
