@@ -1635,18 +1635,21 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None, name=No
     return out
 
 
-def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=True, name=None):
+def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=True,
+                    sp_engine="auto", name=None):
     """Fused flash attention over [batch, heads, time, head_dim] tensors
     (pallas TPU kernel; see parallel/flash_attention.py).  ``kv_lens``
     ([batch] int) applies a key padding mask without building a [T, S]
     bias.  No reference analog — the reference composes matmul+softmax.
 
     Under a ``ParallelExecutor`` whose ``mesh_shape`` carries a
-    non-trivial ``sp`` axis, this op runs ring attention
-    (parallel/ring_attention.py): the time dimension is block-sharded
-    across devices and K/V blocks rotate over ICI.  Pass
-    ``sequence_parallel=False`` to force the single-shard kernel; without
-    an sp axis the flag is a no-op."""
+    non-trivial ``sp`` axis, this op runs sequence-parallel: the time
+    dimension is block-sharded across devices.  ``sp_engine``:
+    ``"auto"`` picks Ulysses all-to-all when the head count divides the
+    axis (constant communication volume), ring attention otherwise
+    (ppermute K/V rotation, no head constraint); ``"ring"``/``"ulysses"``
+    force one.  Pass ``sequence_parallel=False`` to force the
+    single-shard kernel; without an sp axis the flags are no-ops."""
     helper = LayerHelper("flash_attention", **locals())
     out = helper.create_variable_for_type_inference(dtype=q.dtype, shape=q.shape)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -1656,6 +1659,7 @@ def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=True,
         type="flash_attention",
         inputs=inputs,
         outputs={"Out": [out]},
-        attrs={"causal": causal, "sequence_parallel": bool(sequence_parallel)},
+        attrs={"causal": causal, "sequence_parallel": bool(sequence_parallel),
+               "sp_engine": sp_engine},
     )
     return out

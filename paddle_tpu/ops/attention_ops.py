@@ -24,20 +24,32 @@ def _flash_attention(ctx, op):
         kv_lens = kv_lens.reshape(-1).astype(jnp.int32)
     causal = bool(op.attrs.get("causal", False))
 
-    # sequence-parallel ring attention over the executor mesh's 'sp' axis:
-    # shard_map blocks T across devices and rotates K/V over ICI (ppermute).
-    # Giving the mesh a non-trivial sp axis IS the opt-in (attr
+    # sequence parallelism over the executor mesh's 'sp' axis.  Giving the
+    # mesh a non-trivial sp axis IS the opt-in (attr
     # sequence_parallel=False forces the single-shard kernel); falls back
-    # when T doesn't divide or kv_lens masking is requested (the ring path
-    # assumes dense blocks).
+    # when T doesn't divide or kv_lens masking is requested (both sp
+    # engines assume dense blocks).  Engine choice ("auto"):
+    # - Ulysses (all-to-all head/sequence re-shard, parallel/ulysses.py)
+    #   when the head count divides the axis — its communication volume is
+    #   constant in sp, vs the ring's p-1 K/V rotations;
+    # - ring attention (ppermute K/V rotation) otherwise — no head
+    #   constraint and sequences can exceed one device's HBM.
     if bool(op.attrs.get("sequence_parallel", True)) and ctx.mesh is not None:
         mesh = ctx.mesh
         axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         sp = int(axis_sizes.get("sp", 1))
         if sp > 1 and kv_lens is None and q.shape[2] % sp == 0:
-            from ..parallel.ring_attention import ring_attention_sharded
+            engine = op.attrs.get("sp_engine", "auto")
+            if engine == "auto":
+                engine = "ulysses" if q.shape[1] % sp == 0 else "ring"
+            if engine == "ulysses":
+                from ..parallel.ulysses import ulysses_attention_sharded
 
-            out = ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=causal)
+                out = ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=causal)
+            else:
+                from ..parallel.ring_attention import ring_attention_sharded
+
+                out = ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=causal)
             ctx.set_output(op, "Out", out)
             return
 

@@ -2,6 +2,7 @@
 training must match single-device training exactly (grad all-reduce = psum),
 mirroring the reference's test_parallel_executor_* equivalence strategy."""
 import numpy as np
+import pytest
 
 import jax
 
@@ -138,10 +139,11 @@ def test_parallel_executor_dp_tp_transformer_matches_replicated():
     np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=1e-6)
 
 
-def test_parallel_executor_sp_ring_attention_matches_single_device():
-    """flash_attention(sequence_parallel=True) under a mesh with an 'sp'
-    axis runs ring attention over the sequence shards; numerics must match
-    the single-device composed path."""
+@pytest.mark.parametrize("n_head,sp_engine", [(2, "ring"), (8, "auto"), (8, "ulysses")])
+def test_parallel_executor_sp_attention_matches_single_device(n_head, sp_engine):
+    """flash_attention under a mesh with an 'sp' axis runs sequence-
+    parallel (ring, or ulysses when heads divide); numerics must match the
+    single-device path."""
     assert jax.device_count() >= 8
 
     def build():
@@ -150,17 +152,18 @@ def test_parallel_executor_sp_ring_attention_matches_single_device():
         startup = fluid.Program()
         startup.random_seed = 13
         with fluid.program_guard(main, startup):
-            q = fluid.layers.data(name="q", shape=[2, 16, 8], dtype="float32")
-            k = fluid.layers.data(name="k", shape=[2, 16, 8], dtype="float32")
-            v = fluid.layers.data(name="v", shape=[2, 16, 8], dtype="float32")
-            o = fluid.layers.flash_attention(q, k, v, causal=True, sequence_parallel=True)
+            q = fluid.layers.data(name="q", shape=[n_head, 16, 8], dtype="float32")
+            k = fluid.layers.data(name="k", shape=[n_head, 16, 8], dtype="float32")
+            v = fluid.layers.data(name="v", shape=[n_head, 16, 8], dtype="float32")
+            o = fluid.layers.flash_attention(q, k, v, causal=True,
+                                             sp_engine=sp_engine)
             s = fluid.layers.reduce_sum(o)
         return main, startup, s
 
     rng = np.random.RandomState(5)
-    Q = rng.randn(4, 2, 16, 8).astype("float32")
-    K = rng.randn(4, 2, 16, 8).astype("float32")
-    V = rng.randn(4, 2, 16, 8).astype("float32")
+    Q = rng.randn(4, n_head, 16, 8).astype("float32")
+    K = rng.randn(4, n_head, 16, 8).astype("float32")
+    V = rng.randn(4, n_head, 16, 8).astype("float32")
     feed = {"q": Q, "k": K, "v": V}
 
     main, startup, s = build()
