@@ -8,8 +8,11 @@ Runs any model from the zoo for N timed iterations and reports throughput:
           word2vec deepfm ocr_crnn_ctc ssd recommender label_semantic_roles
 
 On TPU, image/transformer models run bf16-on-MXU shapes; on CPU shapes are
-shrunk so the run stays quick.  Synthetic data (same as the reference's
---use_fake_data path) so results measure compute, not input IO.
+shrunk so the run stays quick.  Synthetic data by default (the reference's
+--use_fake_data path) so results measure compute, not input IO;
+``--real_data`` feeds image models from the real input pipeline
+(jpeg corpus -> pre-decoded uint8 recordio -> crop/flip workers, see
+reader/image_pipeline.py — the reference's non-fake-data mode).
 """
 from __future__ import annotations
 
@@ -136,6 +139,9 @@ def main():
     ap.add_argument("--batch_size", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--skip_first", type=int, default=3)
+    ap.add_argument("--real_data", action="store_true",
+                    help="feed image models from the real input pipeline "
+                         "(decoded uint8 recordio; image models only)")
     args = ap.parse_args()
 
     import paddle_tpu as fluid
@@ -151,6 +157,37 @@ def main():
     model = build(args.model, batch, on_tpu)
     rng = np.random.RandomState(0)
     feeds, units, unit_name = _synth(args.model, model, batch, rng)
+
+    next_feed = lambda: feeds  # noqa: E731
+    if args.real_data:
+        if args.model not in ("mnist", "vgg16", "resnet50", "se_resnext"):
+            raise SystemExit("--real_data supports image models only")
+        import tempfile
+
+        from paddle_tpu.reader.image_pipeline import (
+            batched_images, convert_decoded_to_recordio, decoded_pipeline,
+            synthesize_jpeg_corpus, normalize_batch)
+
+        shape = model.get("image_shape", (3, 224, 224))
+        size = shape[1]
+        d = tempfile.mkdtemp(prefix="fb_real_")
+        samples = synthesize_jpeg_corpus(d, n=max(256, 2 * batch),
+                                         size=size + 32, classes=1000)
+        shards = convert_decoded_to_recordio(
+            samples, os.path.join(d, "dec"), stored_size=size + 32)
+        reader = decoded_pipeline(shards, mode="train", image_size=size,
+                                  epochs=10_000, output="uint8")
+        batches = batched_images(reader, batch)()
+        img_key = "pixel" if args.model == "mnist" else "data"
+
+        def next_feed():
+            imgs, labels = next(batches)
+            x = normalize_batch(imgs)
+            if args.model == "mnist":  # grayscale 28x28 model
+                x = x[:, :1, :28, :28]
+            lab = labels % (10 if args.model == "mnist" else 1000)
+            return {img_key: x.astype("float32"), "label": lab}
+
     from paddle_tpu.executor import Executor
 
     exe = Executor(fluid.TPUPlace() if on_tpu else fluid.CPUPlace())
@@ -159,10 +196,10 @@ def main():
     with fluid.scope_guard(scope):
         exe.run(model["startup"], scope=scope)
         for _ in range(args.skip_first):
-            exe.run(model["main"], feed=feeds, fetch_list=[model["loss"]], scope=scope)
+            exe.run(model["main"], feed=next_feed(), fetch_list=[model["loss"]], scope=scope)
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = exe.run(model["main"], feed=feeds, fetch_list=[model["loss"]], scope=scope)
+            out = exe.run(model["main"], feed=next_feed(), fetch_list=[model["loss"]], scope=scope)
         np.asarray(out[0])
         dt = time.perf_counter() - t0
 
