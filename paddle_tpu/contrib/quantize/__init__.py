@@ -1,8 +1,14 @@
 """Quantization (reference: python/paddle/fluid/contrib/quantize/)."""
+from .int8_inference import Int8InferenceTranspiler  # noqa: F401
 from .quantize_transpiler import (  # noqa: F401
     QuantizeTranspiler,
     quantize_weight_abs_max,
     dequantize_weight_abs_max,
 )
 
-__all__ = ["QuantizeTranspiler", "quantize_weight_abs_max", "dequantize_weight_abs_max"]
+__all__ = [
+    "QuantizeTranspiler",
+    "Int8InferenceTranspiler",
+    "quantize_weight_abs_max",
+    "dequantize_weight_abs_max",
+]
