@@ -219,6 +219,73 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
     }
 
 
+def bench_resnet_inference(on_tpu):
+    """ResNet-50 forward-only throughput, bf16 vs int8 execution
+    (contrib.quantize.Int8InferenceTranspiler): the MXU's int8 path runs
+    2x the bf16 MAC rate on v5e, so int8 inference is the perf ceiling
+    check for the quantized stack."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.quantize import Int8InferenceTranspiler
+    from paddle_tpu.jax_bridge import init_state, program_to_fn
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    batch = 256 if on_tpu else 8
+    dtype = "float32"  # weights f32; activations cast per mode below
+
+    with fluid.unique_name.guard():
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            image = fluid.layers.data(name="data", shape=[3, 224, 224], dtype=dtype)
+            predict = resnet_imagenet(image, class_dim=1000, depth=50, is_train=False)
+        infer = main.clone(for_test=True)
+    state = init_state(startup)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 3, 224, 224).astype(np.float32)
+    iters = 30 if on_tpu else 2
+
+    def run(prog, st, tag):
+        import jax.numpy as jnp
+
+        fn = program_to_fn(prog, [predict], is_test=True)
+        stc = {k: (jnp.asarray(v, jnp.bfloat16)
+                   if tag == "bf16" and hasattr(v, "dtype") and v.dtype == np.float32 else v)
+               for k, v in st.items()}
+        xx = jnp.asarray(x, jnp.bfloat16) if tag == "bf16" else x
+        jitted = jax.jit(fn)
+        out = jitted(stc, {"data": xx})
+        np.asarray(out[0][0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jitted(stc, {"data": xx})
+        np.asarray(out[0][0, 0])
+        return batch * iters / (time.perf_counter() - t0)
+
+    ips_bf16 = run(infer, dict(state), "bf16")
+
+    class _Scope(dict):
+        pass
+
+    s = _Scope(state)
+    Int8InferenceTranspiler().transpile(infer, s)
+    state_q = dict(state)
+    state_q.update({k: np.asarray(v) for k, v in s.items()
+                    if k.endswith((".int8", ".scale"))})
+    ips_int8 = run(infer, state_q, "int8")
+
+    return {
+        "metric": "resnet50_int8_infer_images_per_sec_per_chip",
+        "value": round(ips_int8, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "bf16_infer_images_per_sec": round(ips_bf16, 2),
+        "int8_speedup_vs_bf16": round(ips_int8 / ips_bf16, 3) if ips_bf16 else None,
+    }
+
+
 def _transformer_train_flops_per_step(batch, seq, n_layer, d, d_inner, vocab):
     """Analytic matmul FLOPs for one training step (2·m·n·k per matmul,
     backward ≈ 2× forward)."""
@@ -307,6 +374,14 @@ def main():
     except Exception as e:  # noqa: BLE001
         extras.append({
             "metric": "resnet50_real_input_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+            "error": "%s: %s" % (type(e).__name__, e)})
+        traceback.print_exc(file=sys.stderr)
+    try:
+        extras.append(bench_resnet_inference(on_tpu))
+    except Exception as e:  # noqa: BLE001
+        extras.append({
+            "metric": "resnet50_int8_infer_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
             "error": "%s: %s" % (type(e).__name__, e)})
         traceback.print_exc(file=sys.stderr)
