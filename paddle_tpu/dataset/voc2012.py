@@ -2,17 +2,29 @@
 segmentation pairs; the SSD pipeline also consumes VOC-style detection
 boxes, so this module serves both):
 
-- ``train()/test()/val()``: (image 3xHxW float32 [0,1], label HxW int32
-  segmentation map) like the reference.
+- ``train()/test()/val()``: (image HWC uint8, label HW segmentation map)
+  like the reference.  If the real archive
+  ``DATA_HOME/voc2012/VOCtrainval_11-May-2012.tar`` is present
+  (user-supplied — no network here), the reference's exact members are
+  parsed: ``ImageSets/Segmentation/{trainval,train,val}.txt`` index
+  ``JPEGImages/<id>.jpg`` + ``SegmentationClass/<id>.png`` (the
+  train/test/val split-file mapping mirrors the reference: train()
+  reads trainval, test() reads train, val() reads val).  Otherwise a
+  synthetic corpus (3xHxW float32 [0,1] images + int32 maps — the
+  shapes the in-repo models/tests consume).
 - ``train_detection()/test_detection()``: (image 3x300x300, gt boxes
   [N,4] float32 normalized xmin/ymin/xmax/ymax, gt labels [N] int64,
   difficult [N] int64) for the SSD model.
 """
 from __future__ import annotations
 
+import io
+import os
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test", "val", "train_detection", "test_detection"]
 
@@ -21,8 +33,37 @@ H = W = 96
 SIZES = {"train": 64, "test": 16, "val": 16}
 DET_SIZE = {"train": 128, "test": 32}
 
+_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/%s.txt"
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/%s.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/%s.png"
+
+
+def _tar_path():
+    p = os.path.join(DATA_HOME, "voc2012", "VOCtrainval_11-May-2012.tar")
+    return p if os.path.exists(p) else None
+
+
+def _real_seg_reader(sub_name):
+    def reader():
+        from PIL import Image
+
+        with tarfile.open(_tar_path()) as tf:
+            ids = tf.extractfile(_SET_FILE % sub_name).read().decode().split()
+            for image_id in ids:
+                img = Image.open(io.BytesIO(tf.extractfile(_DATA_FILE % image_id).read()))
+                lab = Image.open(io.BytesIO(tf.extractfile(_LABEL_FILE % image_id).read()))
+                yield np.array(img), np.array(lab)
+
+    return reader
+
+
+# reference split-file mapping: train()->trainval, test()->train, val()->val
+_REAL_SUB = {"train": "trainval", "test": "train", "val": "val"}
+
 
 def _seg_reader(split):
+    if _tar_path() is not None:
+        return _real_seg_reader(_REAL_SUB[split])
     def reader():
         r = rng_for("voc2012", split)
         for _ in range(SIZES[split]):

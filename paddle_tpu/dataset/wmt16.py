@@ -1,26 +1,100 @@
-"""WMT16 en-de (reference: python/paddle/dataset/wmt16.py).
+"""WMT16 / Multi30K en-de (reference: python/paddle/dataset/wmt16.py).
 
-Synthetic parallel corpus, reference schema: (src_ids, trg_in, trg_next)
-with separate src/trg dict sizes and <s>/<e>/<unk> = 0/1/2.
+If the real archive sits at ``DATA_HOME/wmt16/wmt16.tar.gz``
+(user-supplied — no network here), it is parsed like the reference:
+members ``wmt16/{train,test,val}`` hold tab-separated parallel sentences,
+per-language frequency dictionaries are built from the train split (top
+``dict_size - 3`` words after the ``<s>/<e>/<unk>`` = 0/1/2 specials) and
+cached to ``DATA_HOME/wmt16/<lang>_<size>.dict``; samples are
+``(src_ids, trg_in, trg_next)`` with ``<s>``-wrapped source and shifted
+target, ``src_lang`` flipping the column order.  Otherwise synthetic:
+a deterministic per-token mapping corpus with the same schema.
 """
 from __future__ import annotations
 
+import os
+import tarfile
+from collections import defaultdict
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test", "validation", "get_dict"]
 
 TRAIN_SIZE = 512
 TEST_SIZE = 128
+START, END, UNK = "<s>", "<e>", "<unk>"
+
+_dict_cache: dict = {}
+
+
+def _tar_path():
+    p = os.path.join(DATA_HOME, "wmt16", "wmt16.tar.gz")
+    return p if os.path.exists(p) else None
+
+
+def _build_dict(tar, dict_size, lang):
+    """Frequency dict from the train split (reference __build_dict), cached
+    on disk in the reference's one-word-per-line format."""
+    path = os.path.join(DATA_HOME, "wmt16", "%s_%d.dict" % (lang, dict_size))
+    if not (os.path.exists(path) and
+            sum(1 for _ in open(path, "rb")) == dict_size):
+        freq: dict = defaultdict(int)
+        col = 0 if lang == "en" else 1
+        with tarfile.open(tar) as tf:
+            for raw in tf.extractfile("wmt16/train"):
+                parts = raw.decode("utf-8", "replace").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    freq[w] += 1
+        ranked = sorted(freq.items(), key=lambda kv: -kv[1])
+        with open(path, "w") as f:
+            f.write("%s\n%s\n%s\n" % (START, END, UNK))
+            for w, _ in ranked[: dict_size - 3]:
+                f.write("%s\n" % w)
+    out = {}
+    with open(path, "rb") as f:
+        for i, line in enumerate(f):
+            out[line.decode("utf-8").strip()] = i
+    return out
+
+
+def _real_dict(dict_size, lang):
+    key = (lang, dict_size)
+    if key not in _dict_cache:
+        _dict_cache[key] = _build_dict(_tar_path(), dict_size, lang)
+    return _dict_cache[key]
 
 
 def get_dict(lang, dict_size, reverse=False):
-    d = {"%s%d" % (lang, i): i for i in range(dict_size)}
+    if _tar_path() is not None:
+        d = _real_dict(dict_size, lang)
+    else:
+        d = {"%s%d" % (lang, i): i for i in range(dict_size)}
     return {v: k for k, v in d.items()} if reverse else d
 
 
-def _reader(split, size, src_dict_size, trg_dict_size):
+def _real_reader(member, src_dict_size, trg_dict_size, src_lang):
+    def reader():
+        src_dict = _real_dict(src_dict_size, src_lang)
+        trg_dict = _real_dict(trg_dict_size, "de" if src_lang == "en" else "en")
+        bos, eos, unk = src_dict[START], src_dict[END], src_dict[UNK]
+        src_col = 0 if src_lang == "en" else 1
+        with tarfile.open(_tar_path()) as tf:
+            for raw in tf.extractfile(member):
+                parts = raw.decode("utf-8", "replace").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [bos] + [src_dict.get(w, unk) for w in parts[src_col].split()] + [eos]
+                trg = [trg_dict.get(w, unk) for w in parts[1 - src_col].split()]
+                yield src, [bos] + trg, trg + [eos]
+
+    return reader
+
+
+def _synth_reader(split, size, src_dict_size, trg_dict_size):
     def reader():
         r = rng_for("wmt16", split)
         for _ in range(size):
@@ -32,13 +106,19 @@ def _reader(split, size, src_dict_size, trg_dict_size):
     return reader
 
 
+def _reader(member, split, size, src_dict_size, trg_dict_size, src_lang):
+    if _tar_path() is not None:
+        return _real_reader(member, src_dict_size, trg_dict_size, src_lang)
+    return _synth_reader(split, size, src_dict_size, trg_dict_size)
+
+
 def train(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader("train", TRAIN_SIZE, src_dict_size, trg_dict_size)
+    return _reader("wmt16/train", "train", TRAIN_SIZE, src_dict_size, trg_dict_size, src_lang)
 
 
 def test(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader("test", TEST_SIZE, src_dict_size, trg_dict_size)
+    return _reader("wmt16/test", "test", TEST_SIZE, src_dict_size, trg_dict_size, src_lang)
 
 
 def validation(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader("validation", TEST_SIZE, src_dict_size, trg_dict_size)
+    return _reader("wmt16/val", "val", TEST_SIZE, src_dict_size, trg_dict_size, src_lang)

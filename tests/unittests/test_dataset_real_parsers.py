@@ -146,3 +146,90 @@ def test_synthetic_fallback_without_archives(fake_home):
     assert len(gram) == 5
     row = next(iter(movielens.train()()))
     assert len(row) == 8
+
+
+def test_wmt16_parses_tarball(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import wmt16
+
+    monkeypatch.setattr(wmt16, "DATA_HOME", str(tmp_path))
+    wmt16._dict_cache = {}
+    d = os.path.join(str(tmp_path), "wmt16")
+    os.makedirs(d)
+    with tarfile.open(os.path.join(d, "wmt16.tar.gz"), "w:gz") as tf:
+        _add_text(tf, "wmt16/train",
+                  "a cat sat\teine katze sass\n"
+                  "a dog ran\tein hund lief\n"
+                  "a cat ran\teine katze lief\n")
+        _add_text(tf, "wmt16/test", "a dog sat\tein hund sass\n")
+        _add_text(tf, "wmt16/val", "a cat sat\teine katze sass\n")
+
+    sd = wmt16.get_dict("en", 8)
+    td = wmt16.get_dict("de", 8)
+    # specials at 0/1/2, then frequency order: 'a' is the most frequent
+    assert sd["<s>"] == 0 and sd["<e>"] == 1 and sd["<unk>"] == 2
+    assert sd["a"] == 3
+    assert td["<s>"] == 0 and "katze" in td
+
+    train = list(wmt16.train(8, 8)())
+    assert len(train) == 3
+    src, trg_in, trg_next = train[0]
+    assert src[0] == 0 and src[-1] == 1          # <s> ... <e>
+    assert trg_in[0] == 0 and trg_next[-1] == 1  # shifted pair
+    assert trg_in[1:] == trg_next[:-1]
+    assert src[1] == sd["a"]
+
+    # de->en flips the columns
+    rev = list(wmt16.train(8, 8, src_lang="de")())
+    assert rev[0][0][1] == td["eine"]
+
+    test = list(wmt16.test(8, 8)())
+    val = list(wmt16.validation(8, 8)())
+    assert len(test) == 1 and len(val) == 1
+
+    # dict files cached in the reference's on-disk format
+    assert os.path.exists(os.path.join(d, "en_8.dict"))
+    wmt16._dict_cache = {}
+
+
+def test_voc2012_parses_voctrainval_tar(tmp_path, monkeypatch):
+    from PIL import Image
+
+    from paddle_tpu.dataset import voc2012
+
+    monkeypatch.setattr(voc2012, "DATA_HOME", str(tmp_path))
+    d = os.path.join(str(tmp_path), "voc2012")
+    os.makedirs(d)
+
+    def _img_bytes(mode, size, value, fmt):
+        buf = io.BytesIO()
+        Image.new(mode, size, value).save(buf, fmt)
+        return buf.getvalue()
+
+    def _add_bytes(tf, name, data):
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+
+    with tarfile.open(os.path.join(d, "VOCtrainval_11-May-2012.tar"), "w") as tf:
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+                   b"img_a\nimg_b\n")
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                   b"img_a\n")
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   b"img_b\n")
+        for name, shade in (("img_a", 100), ("img_b", 200)):
+            _add_bytes(tf, "VOCdevkit/VOC2012/JPEGImages/%s.jpg" % name,
+                       _img_bytes("RGB", (12, 10), (shade, 0, 0), "JPEG"))
+            # "L" mode: PIL's PNG save optimizes P-mode palettes (index 5
+            # would come back remapped); gray value 5 is stable
+            _add_bytes(tf, "VOCdevkit/VOC2012/SegmentationClass/%s.png" % name,
+                       _img_bytes("L", (12, 10), 5, "PNG"))
+
+    train = list(voc2012.train()())       # reads trainval.txt: 2 samples
+    assert len(train) == 2
+    img, lab = train[0]
+    assert img.shape == (10, 12, 3) and img.dtype == np.uint8  # HWC, reference order
+    assert lab.shape == (10, 12) and int(lab[0, 0]) == 5
+    assert abs(int(img[0, 0, 0]) - 100) < 12  # jpeg-lossy red channel
+    assert len(list(voc2012.test()())) == 1   # train.txt
+    assert len(list(voc2012.val()())) == 1    # val.txt
