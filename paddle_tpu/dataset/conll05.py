@@ -1,15 +1,29 @@
 """CoNLL-2005 SRL (reference: python/paddle/dataset/conll05.py).
 
-Synthetic sequence-labeling data with the reference's 8-slot sample schema:
-(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids(mark), label_ids)
-— each a python list of int64 per token; labels use an IOB tagset so
-chunk_eval / CRF training behave like on the real corpus.
+Sample schema (8 slots, per-token int64 lists):
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark, label_ids).
+
+Real mode: place the reference's exact files under
+``DATA_HOME/conll05st/`` — ``conll05st-tests.tar.gz`` (the
+``test.wsj.words.gz`` / ``test.wsj.props.gz`` members),
+``wordDict.txt`` / ``verbDict.txt`` / ``targetDict.txt`` and optionally
+the binary ``emb`` — and the props bracket notation is expanded to BIO
+tags per predicate exactly like the reference (one sample per predicate,
+predicate-context features ctx_n2..ctx_p2 repeated over the sentence,
+mark flags the +/-2 window).  Synthetic mode keeps the same schema with
+an IOB tagset correlated to word parity so chunk_eval / CRF training
+behave like on the real corpus (its ctx_* are sliding windows — a
+documented divergence; real mode follows the reference).
 """
 from __future__ import annotations
 
+import gzip
+import os
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["get_dict", "get_embedding", "test", "train"]
 
@@ -18,9 +32,58 @@ NUM_LABEL_TYPES = 5  # chunk types -> tags 0..(2*5); 10 = O
 LABEL_VOCAB = 2 * NUM_LABEL_TYPES + 1
 TRAIN_SIZE = 256
 TEST_SIZE = 64
+UNK_IDX = 0
+
+_real_dicts_cache = None
+
+
+def _real_dir():
+    d = os.path.join(DATA_HOME, "conll05st")
+    need = ("conll05st-tests.tar.gz", "wordDict.txt", "verbDict.txt", "targetDict.txt")
+    if all(os.path.exists(os.path.join(d, n)) for n in need):
+        return d
+    return None
+
+
+def _load_line_dict(path):
+    with open(path) as f:
+        return {line.strip(): i for i, line in enumerate(f)}
+
+
+def _load_label_dict(path):
+    """targetDict.txt lists B-/I- tags; ids pair B/I per tag, O last
+    (reference load_label_dict)."""
+    tags = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith(("B-", "I-")):
+                tags.add(line[2:])
+    d = {}
+    idx = 0
+    for tag in sorted(tags):
+        d["B-" + tag] = idx
+        d["I-" + tag] = idx + 1
+        idx += 2
+    d["O"] = idx
+    return d
+
+
+def _real_dicts():
+    global _real_dicts_cache
+    if _real_dicts_cache is None:
+        d = _real_dir()
+        _real_dicts_cache = (
+            _load_line_dict(os.path.join(d, "wordDict.txt")),
+            _load_line_dict(os.path.join(d, "verbDict.txt")),
+            _load_label_dict(os.path.join(d, "targetDict.txt")),
+        )
+    return _real_dicts_cache
 
 
 def get_dict():
+    if _real_dir() is not None:
+        return _real_dicts()
     word_dict = {"w%d" % i: i for i in range(WORD_VOCAB)}
     verb_dict = {"v%d" % i: i for i in range(200)}
     label_dict = {}
@@ -32,8 +95,90 @@ def get_dict():
 
 
 def get_embedding():
+    d = _real_dir()
+    if d is not None and os.path.exists(os.path.join(d, "emb")):
+        word_dict = _real_dicts()[0]
+        emb = np.fromfile(os.path.join(d, "emb"), dtype="<f4")
+        return emb.reshape(len(word_dict), -1)
     r = rng_for("conll05", "emb")
     return r.randn(WORD_VOCAB, 32).astype("float32")
+
+
+def _expand_props(labels_col):
+    """One predicate's props column (bracket notation) -> BIO tags
+    (reference corpus_reader's state machine)."""
+    out = []
+    cur, inside = "O", False
+    for tok in labels_col:
+        if tok == "*":
+            out.append("I-" + cur if inside else "O")
+        elif tok == "*)":
+            out.append("I-" + cur)
+            inside = False
+        elif "(" in tok and ")" in tok:
+            cur = tok[1: tok.find("*")]
+            out.append("B-" + cur)
+            inside = False
+        elif "(" in tok:
+            cur = tok[1: tok.find("*")]
+            out.append("B-" + cur)
+            inside = True
+        else:
+            raise ValueError("unexpected props token %r" % tok)
+    return out
+
+
+def _real_sentences(tar_path, words_name, props_name):
+    """Yield (words, predicate, bio_tags) per predicate per sentence."""
+    with tarfile.open(tar_path) as tf:
+        with gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wf, \
+                gzip.GzipFile(fileobj=tf.extractfile(props_name)) as pf:
+            words, cols = [], []
+            for wline, pline in zip(wf, pf):
+                w = wline.decode("utf-8").strip()
+                p = pline.decode("utf-8").strip().split()
+                if not p:  # sentence boundary
+                    if cols:
+                        verbs = [v for v in (row[0] for row in cols) if v != "-"]
+                        n_preds = len(cols[0]) - 1
+                        for i in range(n_preds):
+                            tags = _expand_props([row[i + 1] for row in cols])
+                            yield words, verbs[i], tags
+                    words, cols = [], []
+                else:
+                    words.append(w)
+                    cols.append(p)
+
+
+def _real_reader():
+    def reader():
+        d = _real_dir()
+        word_dict, verb_dict, label_dict = _real_dicts()
+        tar = os.path.join(d, "conll05st-tests.tar.gz")
+        base = "conll05st-release/test.wsj"
+        for words, predicate, tags in _real_sentences(
+                tar, base + "/words/test.wsj.words.gz",
+                base + "/props/test.wsj.props.gz"):
+            L = len(words)
+            v = tags.index("B-V")
+            mark = [0] * L
+            ctx = {}
+            for off, key in ((-2, "n2"), (-1, "n1"), (0, "0"), (1, "p1"), (2, "p2")):
+                j = v + off
+                if 0 <= j < L:
+                    mark[j] = 1
+                    ctx[key] = words[j]
+                else:
+                    ctx[key] = "bos" if off < 0 else "eos"
+            word_idx = [word_dict.get(w, UNK_IDX) for w in words]
+
+            def rep(key):
+                return [word_dict.get(ctx[key], UNK_IDX)] * L
+
+            yield (word_idx, rep("n2"), rep("n1"), rep("0"), rep("p1"),
+                   rep("p2"), mark, [label_dict[t] for t in tags])
+
+    return reader
 
 
 def _reader(split, size):
@@ -72,8 +217,14 @@ def _reader(split, size):
 
 
 def train():
+    # the reference trains on the test set too (the train corpus is not
+    # freely distributable)
+    if _real_dir() is not None:
+        return _real_reader()
     return _reader("train", TRAIN_SIZE)
 
 
 def test():
+    if _real_dir() is not None:
+        return _real_reader()
     return _reader("test", TEST_SIZE)

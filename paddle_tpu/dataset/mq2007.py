@@ -1,16 +1,22 @@
 """MQ2007 learning-to-rank (reference: python/paddle/dataset/mq2007.py).
 
-Synthetic LETOR-style data: queries with candidate docs, 46-dim features,
-relevance in {0,1,2}; ``format`` selects pointwise/pairwise/listwise
-exactly like the reference reader.
+If the extracted LETOR Fold1 files are present (user-supplied — the
+reference ships a .rar whose extraction needs unrar; place the extracted
+``Fold1/{train,test}.txt`` under ``DATA_HOME/mq2007/`` or the
+reference's ``MQ2007/MQ2007/Fold1`` layout), lines are parsed in the
+LETOR 4.0 format ``rel qid:N 1:v ... 46:v #docid = ...`` and grouped by
+query.  Otherwise synthetic LETOR-style data: queries with candidate
+docs, 46-dim features, relevance in {0,1,2}.  ``format`` selects
+pointwise/pairwise/listwise exactly like the reference reader.
 """
 from __future__ import annotations
 
 import itertools
+import os
 
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test"]
 
@@ -23,7 +29,56 @@ def _w():
     return rng_for("mq2007", "w").randn(FEATURE_DIM).astype("float32")
 
 
+def _real_path(split):
+    base = os.path.join(DATA_HOME, "mq2007")
+    for rel in ("Fold1/%s.txt" % split, "MQ2007/MQ2007/Fold1/%s.txt" % split):
+        p = os.path.join(base, rel)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _parse_letor_line(line):
+    """``rel qid:N 1:v 2:v ... #docid = X`` -> (rel, qid, feats[46])."""
+    body = line.split("#", 1)[0].split()
+    if len(body) < 2:
+        return None
+    rel = int(body[0])
+    qid = int(body[1].split(":", 1)[1])
+    feats = np.zeros(FEATURE_DIM, "float32")
+    for tok in body[2:]:
+        k, v = tok.split(":", 1)
+        idx = int(k) - 1  # LETOR features are 1-based
+        if 0 <= idx < FEATURE_DIM:
+            feats[idx] = float(v)
+    return rel, qid, feats
+
+
+def _real_queries(path):
+    """Group consecutive same-qid lines into one query (LETOR files are
+    qid-sorted, as the reference's QueryList assumes)."""
+    cur_qid, rels, feats = None, [], []
+    with open(path) as f:
+        for line in f:
+            parsed = _parse_letor_line(line.strip())
+            if parsed is None:
+                continue
+            rel, qid, fv = parsed
+            if cur_qid is not None and qid != cur_qid:
+                yield np.asarray(rels, "int64"), np.stack(feats)
+                rels, feats = [], []
+            cur_qid = qid
+            rels.append(rel)
+            feats.append(fv)
+    if rels:
+        yield np.asarray(rels, "int64"), np.stack(feats)
+
+
 def _queries(split, count):
+    real = _real_path(split)
+    if real is not None:
+        yield from _real_queries(real)
+        return
     r = rng_for("mq2007", split)
     w = _w()
     for qid in range(count):

@@ -1,26 +1,96 @@
 """Movie-review sentiment (reference: python/paddle/dataset/sentiment.py,
-NLTK movie_reviews corpus).  Synthetic, same scheme as imdb but smaller
-vocab; samples are ([int64 ids], label 0/1).
+NLTK movie_reviews corpus).
+
+If the NLTK-layout archive ``DATA_HOME/corpora/movie_reviews.zip``
+exists (user-supplied — no network here), it is parsed like the
+reference: members ``movie_reviews/{neg,pos}/*.txt``, words ranked by
+global frequency into ids, neg/pos files interleaved (the reference's
+``sort_files`` zip), label 0 for neg / 1 for pos, first 80% of samples
+to ``train()`` and the rest to ``test()``.  Tokenization is a
+lowercased word/punctuation regex rather than NLTK's tokenizer, so id
+assignments can differ from the reference on edge tokens (NLTK is not
+in this environment).  Otherwise synthetic: same scheme as imdb but
+smaller vocab; samples are ([int64 ids], label 0/1).
 """
 from __future__ import annotations
 
+import os
+import re
+import zipfile
+from collections import defaultdict
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["get_word_dict", "train", "test"]
 
 VOCAB = 1000
 TRAIN_SIZE = 512
 TEST_SIZE = 128
+_TRAIN_FRACTION = 0.8
+
+_real_cache = None
+_TOKEN_RE = re.compile(r"[a-z0-9]+|[^\sa-z0-9]")
+
+
+def _zip_path():
+    p = os.path.join(DATA_HOME, "corpora", "movie_reviews.zip")
+    return p if os.path.exists(p) else None
+
+
+def _tokens(raw):
+    return _TOKEN_RE.findall(raw.decode("utf-8", "replace").lower())
+
+
+def _load_real():
+    """{'word_dict': [(word, id)...], 'data': [(ids, label)...]} or None."""
+    global _real_cache
+    if _real_cache is not None:
+        return _real_cache
+    path = _zip_path()
+    if path is None:
+        return None
+    docs = {"neg": [], "pos": []}
+    freq: dict = defaultdict(int)
+    with zipfile.ZipFile(path) as zf:
+        for name in sorted(zf.namelist()):
+            m = re.match(r"movie_reviews/(neg|pos)/.*\.txt$", name)
+            if not m:
+                continue
+            toks = _tokens(zf.read(name))
+            docs[m.group(1)].append(toks)
+            for t in toks:
+                freq[t] += 1
+    ranked = sorted(freq.items(), key=lambda kv: -kv[1])
+    word_dict = [(w, i) for i, (w, _) in enumerate(ranked)]
+    ids = dict(word_dict)
+    # the reference interleaves neg/pos files so the split stays balanced
+    data = []
+    for n_doc, p_doc in zip(docs["neg"], docs["pos"]):
+        data.append(([ids[t] for t in n_doc], 0))
+        data.append(([ids[t] for t in p_doc], 1))
+    _real_cache = {"word_dict": word_dict, "data": data}
+    return _real_cache
 
 
 def get_word_dict():
+    real = _load_real()
+    if real is not None:
+        return real["word_dict"]
     return [("w%d" % i, i) for i in range(VOCAB)]
 
 
 def _reader(split, size):
     def reader():
+        real = _load_real()
+        if real is not None:
+            data = real["data"]
+            cut = int(len(data) * _TRAIN_FRACTION)
+            part = data[:cut] if split == "train" else data[cut:]
+            for ids, label in part:
+                yield [int(i) for i in ids], label
+            return
         r = rng_for("sentiment", split)
         for _ in range(size):
             label = int(r.randint(0, 2))

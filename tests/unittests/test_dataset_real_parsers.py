@@ -233,3 +233,130 @@ def test_voc2012_parses_voctrainval_tar(tmp_path, monkeypatch):
     assert abs(int(img[0, 0, 0]) - 100) < 12  # jpeg-lossy red channel
     assert len(list(voc2012.test()())) == 1   # train.txt
     assert len(list(voc2012.val()())) == 1    # val.txt
+
+
+def test_mq2007_parses_letor_fold(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import mq2007
+
+    monkeypatch.setattr(mq2007, "DATA_HOME", str(tmp_path))
+    d = os.path.join(str(tmp_path), "mq2007", "Fold1")
+    os.makedirs(d)
+    lines = [
+        "2 qid:10 1:0.5 2:0.25 46:1.0 #docid = GX000-00",
+        "0 qid:10 1:0.1 3:0.75 #docid = GX000-01",
+        "1 qid:11 2:0.9 #docid = GX001-00",
+    ]
+    with open(os.path.join(d, "train.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(os.path.join(d, "test.txt"), "w") as f:
+        f.write(lines[2] + "\n")
+
+    queries = list(mq2007._queries("train", 0))
+    assert len(queries) == 2  # qid 10 (2 docs) and qid 11 (1 doc)
+    rel, feats = queries[0]
+    assert rel.tolist() == [2, 0]
+    assert feats.shape == (2, 46)
+    assert feats[0, 0] == np.float32(0.5) and feats[0, 45] == np.float32(1.0)
+    assert feats[1, 2] == np.float32(0.75)  # 1-based LETOR index 3
+
+    # reader formats on real data
+    pw = list(mq2007.train(format="pointwise")())
+    assert len(pw) == 3 and pw[0][0] == 2
+    pairs = list(mq2007.train(format="pairwise")())
+    assert len(pairs) == 1  # only qid 10 has a rel difference
+    lw = list(mq2007.test(format="listwise")())
+    assert len(lw) == 1 and list(lw[0][0]) == [1]
+
+
+def test_sentiment_parses_nltk_movie_reviews_zip(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import sentiment
+
+    monkeypatch.setattr(sentiment, "DATA_HOME", str(tmp_path))
+    sentiment._real_cache = None
+    d = os.path.join(str(tmp_path), "corpora")
+    os.makedirs(d)
+    with zipfile.ZipFile(os.path.join(d, "movie_reviews.zip"), "w") as zf:
+        zf.writestr("movie_reviews/neg/cv000_1.txt", "bad bad film")
+        zf.writestr("movie_reviews/neg/cv001_2.txt", "awful film")
+        zf.writestr("movie_reviews/pos/cv000_3.txt", "good good good film")
+        zf.writestr("movie_reviews/pos/cv001_4.txt", "nice film")
+    try:
+        wd = dict(sentiment.get_word_dict())
+        # frequency rank: 'film' (4) > 'good' (3) > 'bad' (2)
+        assert wd["film"] == 0 and wd["good"] == 1 and wd["bad"] == 2
+        train = list(sentiment.train()())
+        test = list(sentiment.test()())
+        assert len(train) + len(test) == 4
+        # interleaved neg/pos: labels alternate 0,1 in corpus order
+        assert [lbl for _, lbl in train + test] == [0, 1, 0, 1]
+        ids, lbl = train[0]
+        assert lbl == 0 and ids == [wd["bad"], wd["bad"], wd["film"]]
+    finally:
+        sentiment._real_cache = None
+
+
+def test_conll05_parses_wsj_archive(tmp_path, monkeypatch):
+    import gzip as _gzip
+
+    from paddle_tpu.dataset import conll05
+
+    monkeypatch.setattr(conll05, "DATA_HOME", str(tmp_path))
+    conll05._real_dicts_cache = None
+    d = os.path.join(str(tmp_path), "conll05st")
+    os.makedirs(d)
+
+    # two-sentence corpus; sentence 1 has 2 predicates (2 props columns),
+    # each predicate's lemma on its own verb row as in the real files
+    words = "The\ncat\nsat\n\nDogs\nrun\n\n"
+    props = ("-     (A0*  (A0*\n"
+             "catv  (V*)  *)\n"
+             "sitv  *     (V*)\n"
+             "\n"
+             "-    (A1*)\n"
+             "run  (V*)\n"
+             "\n")
+    with open(os.path.join(d, "wordDict.txt"), "w") as f:
+        f.write("The\ncat\nsat\nDogs\nrun\nbos\neos\n")
+    with open(os.path.join(d, "verbDict.txt"), "w") as f:
+        f.write("catv\nsitv\nrun\n")
+    with open(os.path.join(d, "targetDict.txt"), "w") as f:
+        f.write("B-A0\nI-A0\nB-A1\nI-A1\nB-V\nI-V\nO\n")
+
+    def _gz(text):
+        buf = io.BytesIO()
+        with _gzip.GzipFile(fileobj=buf, mode="wb") as g:
+            g.write(text.encode())
+        return buf.getvalue()
+
+    with tarfile.open(os.path.join(d, "conll05st-tests.tar.gz"), "w:gz") as tf:
+        for name, text in (("words/test.wsj.words.gz", words),
+                           ("props/test.wsj.props.gz", props)):
+            data = _gz(text)
+            info = tarfile.TarInfo("conll05st-release/test.wsj/" + name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+    try:
+        word_dict, verb_dict, label_dict = conll05.get_dict()
+        assert word_dict["The"] == 0 and verb_dict["run"] == 2
+        assert label_dict["O"] == max(label_dict.values())
+
+        samples = list(conll05.test()())
+        assert len(samples) == 3  # 2 predicates + 1 predicate
+        w, n2, n1, c0, p1, p2, mark, labels = samples[0]
+        assert w == [0, 1, 2]
+        # predicate 1 of sentence 1: A0 at token 0, V at token 1, O after
+        assert labels == [label_dict["B-A0"], label_dict["B-V"], label_dict["O"]]
+        assert mark == [1, 1, 1]  # +/-2 window covers the 3-token sentence
+        assert c0 == [1, 1, 1]    # predicate word 'cat' repeated
+        assert n2 == [word_dict["bos"]] * 3  # verb at 1: no token at -1
+        # predicate 2 of sentence 1: A0 spans 0-1, V at token 2
+        _, _, _, c0b, p1b, _, _, labels_b = samples[1]
+        assert labels_b == [label_dict["B-A0"], label_dict["I-A0"], label_dict["B-V"]]
+        assert c0b == [2, 2, 2]
+        assert p1b == [word_dict["eos"]] * 3
+        # sentence 2: single-token A1 then V
+        _, _, _, _, _, _, _, labels2 = samples[2]
+        assert labels2 == [label_dict["B-A1"], label_dict["B-V"]]
+    finally:
+        conll05._real_dicts_cache = None
