@@ -1,13 +1,27 @@
 """Oxford 102 flowers (reference: python/paddle/dataset/flowers.py).
 
-Synthetic: (3*224*224 float32 image in [0,1], int64 label in [0,102)).
+Real mode: place ``102flowers.tgz`` + ``imagelabels.mat`` + ``setid.mat``
+under ``DATA_HOME/flowers/`` (user-supplied — no network here) and the
+reference's exact pipeline runs: labels from imagelabels.mat, split
+indices from setid.mat with the reference's deliberate flag swap
+(``train()`` reads ``tstid`` — the larger half — ``test()`` reads
+``trnid``), jpg members ``jpg/image_%05d.jpg`` decoded, resize-short 256,
+224 crop (random + flip for train, center otherwise), CHW flattened
+float32 in [0, 1], 0-based labels.  Augmentation draws per-sample
+deterministic generators (``default_rng((seed, index))``) instead of the
+reference's global RNG.  Otherwise synthetic:
+(3*224*224 float32 image in [0,1], int64 label in [0,102)).
 ``mapper``/``batched`` args accepted for API parity.
 """
 from __future__ import annotations
 
+import io
+import os
+import tarfile
+
 import numpy as np
 
-from .common import rng_for
+from .common import DATA_HOME, rng_for
 
 __all__ = ["train", "test", "valid"]
 
@@ -15,8 +29,56 @@ NUM_CLASSES = 102
 SIZES = {"train": 256, "test": 64, "valid": 64}
 IMG_SHAPE = (3, 224, 224)
 
+# the reference trains on the larger 'tstid' half (flowers.py:55-59)
+_SPLIT_FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+
+def _real_dir():
+    d = os.path.join(DATA_HOME, "flowers")
+    need = ("102flowers.tgz", "imagelabels.mat", "setid.mat")
+    if all(os.path.exists(os.path.join(d, n)) for n in need):
+        return d
+    return None
+
+
+def _real_reader(split):
+    def reader():
+        import scipy.io as scio
+        from PIL import Image
+
+        from ..reader.image_pipeline import _center_crop, _resize_short
+
+        d = _real_dir()
+        labels = scio.loadmat(os.path.join(d, "imagelabels.mat"))["labels"][0]
+        indexes = scio.loadmat(os.path.join(d, "setid.mat"))[_SPLIT_FLAG[split]][0]
+        is_train = split == "train"
+        with tarfile.open(os.path.join(d, "102flowers.tgz")) as tf:
+            for pos, i in enumerate(indexes):
+                member = "jpg/image_%05d.jpg" % int(i)
+                img = Image.open(io.BytesIO(tf.extractfile(member).read()))
+                if img.mode != "RGB":
+                    img = img.convert("RGB")
+                img = _resize_short(img, 256)
+                if is_train:
+                    gen = np.random.default_rng([1021, pos])
+                    w, h = img.size
+                    x0 = int(gen.integers(0, max(w - 224, 0) + 1))
+                    y0 = int(gen.integers(0, max(h - 224, 0) + 1))
+                    img = img.crop((x0, y0, x0 + 224, y0 + 224))
+                    if int(gen.integers(0, 2)):
+                        img = img.transpose(Image.FLIP_LEFT_RIGHT)
+                else:
+                    img = _center_crop(img, 224)
+                arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
+                yield arr.reshape(-1), int(labels[int(i) - 1]) - 1
+
+    return reader
+
 
 def _reader(split, use_xmap=True):
+    if _real_dir() is not None:
+        return _real_reader(split)
+
     def reader():
         r = rng_for("flowers", split)
         base = rng_for("flowers", "templates").rand(NUM_CLASSES, 3, 8, 8).astype("float32")

@@ -360,3 +360,42 @@ def test_conll05_parses_wsj_archive(tmp_path, monkeypatch):
         assert labels2 == [label_dict["B-A1"], label_dict["B-V"]]
     finally:
         conll05._real_dicts_cache = None
+
+
+def test_flowers_parses_archive_with_mats(tmp_path, monkeypatch):
+    import scipy.io as scio
+    from PIL import Image
+
+    from paddle_tpu.dataset import flowers
+
+    monkeypatch.setattr(flowers, "DATA_HOME", str(tmp_path))
+    d = os.path.join(str(tmp_path), "flowers")
+    os.makedirs(d)
+
+    # 4 images; labels 1-based per the .mat convention
+    with tarfile.open(os.path.join(d, "102flowers.tgz"), "w:gz") as tf:
+        for i, shade in ((1, 40), (2, 90), (3, 140), (4, 200)):
+            buf = io.BytesIO()
+            Image.new("RGB", (300, 260), (shade, 0, 0)).save(buf, "JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo("jpg/image_%05d.jpg" % i)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    scio.savemat(os.path.join(d, "imagelabels.mat"),
+                 {"labels": np.array([[5, 7, 5, 9]])})
+    scio.savemat(os.path.join(d, "setid.mat"),
+                 {"tstid": np.array([[1, 2, 3]]), "trnid": np.array([[4]]),
+                  "valid": np.array([[4]])})
+
+    train = list(flowers.train()())
+    assert len(train) == 3  # the reference's swap: train reads tstid
+    img, label = train[0]
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+    assert 0.0 <= img.min() and img.max() <= 1.0
+    assert label == 4  # mat label 5, 0-based
+    assert [l for _, l in train] == [4, 6, 4]
+    test = list(flowers.test()())
+    assert len(test) == 1 and test[0][1] == 8
+    # red-channel shade survives decode+crop (value/255 within jpeg loss)
+    red = train[0][0].reshape(3, 224, 224)[0].mean()
+    assert abs(red - 40 / 255) < 0.05
