@@ -41,7 +41,31 @@ def _real_dir():
     return None
 
 
+def _ensure_extracted(d):
+    """Extract the jpg members to disk ONCE (like the reference's
+    batch_images_from_tar pre-pass).  Random-order extractfile() on a
+    .tgz re-decompresses from byte 0 for every backward seek — the split
+    ids are a shuffled permutation, so per-epoch in-tar reads would be
+    quadratic in archive size."""
+    out = os.path.join(d, "extracted")
+    marker = os.path.join(out, ".complete")
+    if os.path.exists(marker):
+        return out
+    os.makedirs(out, exist_ok=True)
+    with tarfile.open(os.path.join(d, "102flowers.tgz")) as tf:
+        for m in tf:  # one sequential pass
+            if m.isfile() and m.name.endswith(".jpg"):
+                dst = os.path.join(out, os.path.basename(m.name))
+                with open(dst, "wb") as f:
+                    f.write(tf.extractfile(m).read())
+    with open(marker, "w") as f:
+        f.write("ok")
+    return out
+
+
 def _real_reader(split):
+    epoch_counter = [0]
+
     def reader():
         import scipy.io as scio
         from PIL import Image
@@ -49,28 +73,30 @@ def _real_reader(split):
         from ..reader.image_pipeline import _center_crop, _resize_short
 
         d = _real_dir()
+        jpg_dir = _ensure_extracted(d)
         labels = scio.loadmat(os.path.join(d, "imagelabels.mat"))["labels"][0]
         indexes = scio.loadmat(os.path.join(d, "setid.mat"))[_SPLIT_FLAG[split]][0]
         is_train = split == "train"
-        with tarfile.open(os.path.join(d, "102flowers.tgz")) as tf:
-            for pos, i in enumerate(indexes):
-                member = "jpg/image_%05d.jpg" % int(i)
-                img = Image.open(io.BytesIO(tf.extractfile(member).read()))
-                if img.mode != "RGB":
-                    img = img.convert("RGB")
-                img = _resize_short(img, 256)
-                if is_train:
-                    gen = np.random.default_rng([1021, pos])
-                    w, h = img.size
-                    x0 = int(gen.integers(0, max(w - 224, 0) + 1))
-                    y0 = int(gen.integers(0, max(h - 224, 0) + 1))
-                    img = img.crop((x0, y0, x0 + 224, y0 + 224))
-                    if int(gen.integers(0, 2)):
-                        img = img.transpose(Image.FLIP_LEFT_RIGHT)
-                else:
-                    img = _center_crop(img, 224)
-                arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
-                yield arr.reshape(-1), int(labels[int(i) - 1]) - 1
+        # new crops/flips every epoch, deterministic per (epoch, sample)
+        epoch = epoch_counter[0]
+        epoch_counter[0] += 1
+        for pos, i in enumerate(indexes):
+            img = Image.open(os.path.join(jpg_dir, "image_%05d.jpg" % int(i)))
+            if img.mode != "RGB":
+                img = img.convert("RGB")
+            img = _resize_short(img, 256)
+            if is_train:
+                gen = np.random.default_rng([1021, epoch, pos])
+                w, h = img.size
+                x0 = int(gen.integers(0, max(w - 224, 0) + 1))
+                y0 = int(gen.integers(0, max(h - 224, 0) + 1))
+                img = img.crop((x0, y0, x0 + 224, y0 + 224))
+                if int(gen.integers(0, 2)):
+                    img = img.transpose(Image.FLIP_LEFT_RIGHT)
+            else:
+                img = _center_crop(img, 224)
+            arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
+            yield arr.reshape(-1), int(labels[int(i) - 1]) - 1
 
     return reader
 

@@ -399,3 +399,34 @@ def test_flowers_parses_archive_with_mats(tmp_path, monkeypatch):
     # red-channel shade survives decode+crop (value/255 within jpeg loss)
     red = train[0][0].reshape(3, 224, 224)[0].mean()
     assert abs(red - 40 / 255) < 0.05
+
+
+def test_flowers_augmentation_varies_per_epoch(tmp_path, monkeypatch):
+    import scipy.io as scio
+    from PIL import Image
+
+    from paddle_tpu.dataset import flowers
+
+    monkeypatch.setattr(flowers, "DATA_HOME", str(tmp_path))
+    d = os.path.join(str(tmp_path), "flowers")
+    os.makedirs(d)
+    with tarfile.open(os.path.join(d, "102flowers.tgz"), "w:gz") as tf:
+        rngimg = np.random.default_rng(0)
+        arr = rngimg.integers(0, 255, size=(260, 300, 3), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, "JPEG")
+        data = buf.getvalue()
+        info = tarfile.TarInfo("jpg/image_00001.jpg")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    scio.savemat(os.path.join(d, "imagelabels.mat"), {"labels": np.array([[1]])})
+    scio.savemat(os.path.join(d, "setid.mat"),
+                 {"tstid": np.array([[1]]), "trnid": np.array([[1]]),
+                  "valid": np.array([[1]])})
+
+    creator = flowers.train()
+    (img_e0, _), = creator()   # epoch 0
+    (img_e1, _), = creator()   # epoch 1: different crop/flip
+    assert not np.array_equal(img_e0, img_e1)
+    # extraction cache materialized once
+    assert os.path.exists(os.path.join(d, "extracted", ".complete"))
