@@ -301,8 +301,39 @@ struct Server {
               }
             }
             if (good) {
-              tables.erase(table);
-              tables.emplace(table, std::move(t));
+              // NEVER erase a live Table: PUSH/PULL handlers on other
+              // connections hold raw Table* obtained under tables_mu and
+              // dereference it after releasing the lock — replacing the
+              // object would be a use-after-free.  New tables are safe to
+              // emplace; existing ones get their payload copied in place
+              // under each row lock (dims must match).
+              auto it = tables.find(table);
+              if (it == tables.end()) {
+                tables.emplace(table, std::move(t));
+              } else if (it->second.rows == rows && it->second.width == width) {
+                Table& dst = it->second;
+                dst.opt = t.opt;
+                dst.eps = t.eps;
+                dst.beta1 = t.beta1;
+                dst.beta2 = t.beta2;
+                dst.accum.resize(t.accum.size());
+                dst.accum2.resize(t.accum2.size());
+                dst.steps.resize(t.steps.size());
+                for (uint32_t r = 0; r < rows; ++r) {
+                  std::lock_guard<std::mutex> lk(dst.row_locks[r]);
+                  memcpy(&dst.data[size_t(r) * width], &t.data[size_t(r) * width],
+                         size_t(width) * 4);
+                  if (!t.accum.empty())
+                    memcpy(&dst.accum[size_t(r) * width], &t.accum[size_t(r) * width],
+                           size_t(width) * 4);
+                  if (!t.accum2.empty())
+                    memcpy(&dst.accum2[size_t(r) * width], &t.accum2[size_t(r) * width],
+                           size_t(width) * 4);
+                  if (!t.steps.empty()) dst.steps[r] = t.steps[r];
+                }
+              } else {
+                ok = 0;  // dimension mismatch with a live table
+              }
             } else {
               ok = 0;
             }

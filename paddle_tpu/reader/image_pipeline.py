@@ -262,6 +262,8 @@ def flowers_records(path_prefix, num_shards=4, data_dir=None, synth_n=256):
 
     from ..dataset.common import DATA_HOME
 
+    import zlib
+
     data_dir = data_dir or os.path.join(DATA_HOME, "flowers")
     archive = os.path.join(data_dir, "102flowers.tgz")
     if os.path.exists(archive):
@@ -277,7 +279,9 @@ def flowers_records(path_prefix, num_shards=4, data_dir=None, synth_n=256):
                 if not os.path.exists(dst):
                     with open(dst, "wb") as f:
                         f.write(tf.extractfile(m).read())
-                samples.append((dst, hash(stem) % 102))
+                # stable hash: python's str hash is salted per process, so
+                # labels from two conversion runs would disagree
+                samples.append((dst, zlib.crc32(stem.encode()) % 102))
     else:
         samples = synthesize_jpeg_corpus(path_prefix + "_synth", n=synth_n)
     return convert_images_to_recordio(samples, path_prefix, num_shards)
@@ -341,6 +345,9 @@ def image_pipeline(files, mode="train", image_size=224, num_workers=8,
                 for _ in range(num_workers):
                     in_q.put(STOP)
 
+        skipped = [0]
+        emitted = [0]
+
         def work():
             while True:
                 item = in_q.get()
@@ -353,8 +360,13 @@ def image_pipeline(files, mode="train", image_size=224, num_workers=8,
                 try:
                     img = process_image(rec[4:], mode, image_size, gen,
                                         color_jitter, output)
-                except Exception:
-                    continue  # corrupt record: skip, as the reference does
+                except (OSError, ValueError, struct.error):
+                    # corrupt record: skip, as the reference does.  Catching
+                    # narrowly (codec/format errors only) keeps systemic
+                    # failures (missing PIL, wrong record schema) loud.
+                    skipped[0] += 1
+                    continue
+                emitted[0] += 1
                 out_q.put((i, img, np.int64(label)))
 
         threads = [threading.Thread(target=feed, daemon=True)]
@@ -369,6 +381,11 @@ def image_pipeline(files, mode="train", image_size=224, num_workers=8,
                 continue
             _i, img, label = item
             yield img, label
+        if skipped[0] and not emitted[0]:
+            raise IOError(
+                "image pipeline decoded 0 of %d records — the shards are "
+                "not in the jpeg-record format (label:u32 | jpeg bytes)?"
+                % skipped[0])
 
     return reader
 

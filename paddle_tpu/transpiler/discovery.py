@@ -227,13 +227,16 @@ class RegistryClient:
         if keepalive and ttl is not None:
             stop = threading.Event()
 
-            def renew():
+            def renew(lease=lease):
                 while not stop.wait(ttl / 3.0):
                     try:
                         st, _ = self._call("keepalive", (key, lease, ttl))
                         if st == "expired":
                             # lease lost (e.g. long GC pause): re-register
-                            self._call("register", (key, value, ttl))
+                            # and ADOPT the new lease id, or every later
+                            # keepalive would keep failing against the
+                            # dead one
+                            _, lease = self._call("register", (key, value, ttl))
                     except (OSError, IOError):
                         pass  # registry briefly down; retry next tick
 

@@ -147,6 +147,11 @@ def serve(executor, program, scope):
     param_names = list(ls.attrs["param_names"])
     opt_block = ls.sub_block
     ckpt_dir = ls.attrs.get("checkpoint_dir")
+    # snapshotting every round would put full-checkpoint disk I/O on every
+    # barrier (the reference checkpoints on an interval); default every 8
+    # rounds, plus an unconditional save on graceful shutdown below
+    ckpt_interval = int(ls.attrs.get("checkpoint_interval", 8) or 1)
+    rounds_done = [0]
 
     if ckpt_dir:
         path = _os.path.join(ckpt_dir, "pserver_params.npz")
@@ -155,8 +160,10 @@ def serve(executor, program, scope):
             for name in loaded.files:
                 scope.vars[name] = loaded[name]
 
-    def _save_checkpoint():
+    def _save_checkpoint(force=False):
         if not ckpt_dir:
+            return
+        if not force and rounds_done[0] % ckpt_interval != 0:
             return
         _os.makedirs(ckpt_dir, exist_ok=True)
         path = _os.path.join(ckpt_dir, "pserver_params.npz")
@@ -191,6 +198,7 @@ def serve(executor, program, scope):
 
     def apply_fn(summed_grads):
         executor.run(apply_prog, feed=dict(summed_grads), fetch_list=[], scope=scope)
+        rounds_done[0] += 1
         _save_checkpoint()
 
     round_ = _SyncRound(fanin)
@@ -241,6 +249,7 @@ def serve(executor, program, scope):
         t.start()
         threads.append(t)
     srv.close()
+    _save_checkpoint(force=True)  # graceful shutdown: persist the latest state
     if registry_client is not None:
         try:
             registry_client.unregister("pservers/" + endpoint)
