@@ -80,6 +80,15 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
     if filename is None:
         for v in vars:
             path = os.path.join(dirname, v.name + ".npy")
+            if not os.path.exists(path) and os.path.exists(
+                    os.path.join(dirname, v.name)):
+                # a directory saved by the REFERENCE framework: one binary
+                # LoDTensor file per var, no .npy suffix (fluid_format.py)
+                from .fluid_format import read_fluid_var_file
+
+                arr, _lod = read_fluid_var_file(os.path.join(dirname, v.name))
+                scope[v.name] = arr
+                continue
             scope[v.name] = np.load(path)
     else:
         data = np.load(os.path.join(dirname, filename) + ("" if filename.endswith(".npz") else ".npz"))

@@ -117,3 +117,33 @@ def test_packed_dims_accepted():
            + struct.pack("<i", len(desc)) + desc + arr.tobytes())
     got, _ = read_fluid_tensor(io.BytesIO(raw))
     np.testing.assert_array_equal(got, arr)
+
+
+def test_load_persistables_accepts_reference_dir(tmp_path):
+    """io.load_persistables transparently reads a directory written by the
+    REFERENCE framework (binary LoDTensor file per var, no .npy)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.fluid_format import write_fluid_var_file
+
+    with fluid.unique_name.guard():
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            fluid.layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="w"),
+                            bias_attr=fluid.ParamAttr(name="b"))
+
+    w = np.random.RandomState(0).randn(4, 2).astype("float32")
+    b = np.array([1.0, -1.0], "float32")
+    d = str(tmp_path / "ref_params")
+    import os as _os
+
+    _os.makedirs(d)
+    write_fluid_var_file(_os.path.join(d, "w"), w)
+    write_fluid_var_file(_os.path.join(d, "b"), b)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.io.load_params(exe, d, main_program=main)
+        np.testing.assert_array_equal(np.asarray(fluid.global_scope()["w"]), w)
+        np.testing.assert_array_equal(np.asarray(fluid.global_scope()["b"]), b)
