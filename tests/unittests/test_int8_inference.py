@@ -89,3 +89,46 @@ def test_int8_weights_storage_halved():
         assert q.dtype == np.int8
         s = np.asarray(fluid.global_scope()["fc_0.w_0.scale"])
         assert s.dtype == np.float32 and s.size == q.shape[1]
+
+
+def test_qat_to_int8_execution_end_to_end():
+    """The full quantization story: QAT-train (fake-quant weights), freeze,
+    then EXECUTE int8 on the quantized inference program — accuracy stays
+    close to the float path because training already absorbed the rounding."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    W_true = rng.randn(8, 4)
+    Y = np.argmax(X @ W_true, axis=1).reshape(-1, 1).astype("int64")
+
+    with fluid.unique_name.guard():
+        main = fluid.Program()
+        startup = fluid.Program()
+        startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            p = fluid.layers.fc(h, size=4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(input=p, label=y))
+            fluid.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+        infer = main.clone(for_test=True)
+
+    qt = fluid.contrib.quantize.QuantizeTranspiler()
+    qt.training_transpile(main)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(60):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        qt.freeze_program(main, fluid.global_scope())
+
+        infer = infer.prune([p])  # drop the loss tail: serve x -> p only
+        (float_pred,) = exe.run(infer, feed={"x": X}, fetch_list=[p])
+        Int8InferenceTranspiler().transpile(infer, fluid.global_scope())
+        (int8_pred,) = exe.run(infer, feed={"x": X}, fetch_list=[p])
+
+    float_acc = (float_pred.argmax(1).reshape(-1, 1) == Y).mean()
+    int8_acc = (int8_pred.argmax(1).reshape(-1, 1) == Y).mean()
+    assert float_acc > 0.8, float_acc
+    assert int8_acc >= float_acc - 0.05, (float_acc, int8_acc)
