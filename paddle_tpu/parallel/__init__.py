@@ -25,4 +25,7 @@ def __getattr__(name):
     if name in ("ulysses_attention", "ulysses_attention_sharded"):
         ul = importlib.import_module(__name__ + ".ulysses")
         return getattr(ul, name)
+    if name in ("pipeline_apply", "pipeline_stage_params"):
+        pl = importlib.import_module(__name__ + ".pipeline")
+        return getattr(pl, name)
     raise AttributeError(name)

@@ -1,0 +1,96 @@
+"""Pipeline parallelism over a mesh axis (GPipe-style, SPMD).
+
+Reference analog: none — Fluid v0.15 scales data-parallel only.  This is
+the TPU-native pipeline engine: layer stages are sharded over the ``pp``
+mesh axis (each device holds ONE stage's parameters), microbatches
+stream through the ring with ``ppermute``, and every device runs the
+same SPMD program — no per-stage processes, no send/recv ops.
+
+Schedule: classic GPipe fill-drain.  With S stages and M microbatches
+the loop runs T = M + S - 1 ticks; at tick t device s applies its stage
+to the activation it received at t-1 and forwards the result to s+1.
+Microbatch m leaves the last stage at tick m + S - 1.  Bubble fraction =
+(S-1)/(M+S-1), the standard GPipe overhead; gradients flow through the
+``ppermute``s (differentiable), so ``jax.grad`` of a pipelined loss is
+pipeline-parallel backward for free.
+
+Constraints (the standard homogeneous-pipeline contract): all stages
+share one ``stage_fn`` (e.g. a transformer block) with per-stage
+parameters stacked on a leading axis, and activations keep one shape
+across stages.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["pipeline_apply", "pipeline_stage_params"]
+
+
+def pipeline_stage_params(per_stage_params):
+    """[pytree per stage] -> one pytree with a leading n_stages axis
+    (shard this axis over 'pp')."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
+                   axis_name="pp"):
+    """Run ``x`` through the S-stage pipeline.
+
+    stage_fn(params_slice, activation) -> activation, applied S times in
+    sequence semantically; stacked_params has leading dim S (sharded over
+    ``axis_name``); x is the full batch [B, ...] with B % n_microbatches
+    == 0.  Returns the full output batch.  Call under jit (the shard_map
+    is internal).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    S = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name])
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError("batch %d %% microbatches %d != 0" % (B, n_microbatches))
+    M = n_microbatches
+    mb = B // M
+    xs = x.reshape((M, mb) + x.shape[1:])
+
+    # per-device views: params [1, ...] (its own stage), xs replicated
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(params, xs):
+        idx = jax.lax.axis_index(axis_name)
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        T = M + S - 1
+
+        def tick(carry, t):
+            held = carry  # activation this device is about to process
+            # stage 0 ingests microbatch t (zeros once the batch is drained)
+            feed = jnp.where(t < M, xs[jnp.minimum(t, M - 1)], jnp.zeros_like(held))
+            inp = jnp.where(idx == 0, feed, held)
+            out = stage_fn(my_params, inp)
+            nxt = jax.lax.ppermute(out, axis_name, perm)
+            # the LAST stage's output at tick t is microbatch t-(S-1)
+            return nxt, out
+
+        zeros = jnp.zeros_like(xs[0])
+        _, outs = jax.lax.scan(tick, zeros, jnp.arange(T))
+        # outs[t] on device S-1 is microbatch t-(S-1); select those M slices
+        last = outs[S - 1:]
+        # only stage S-1 holds the real outputs; psum-broadcast them out
+        mine = jnp.where(idx == S - 1, last, jnp.zeros_like(last))
+        return jax.lax.psum(mine, axis_name)
+
+    ys = run(stacked_params, xs)
+    return ys.reshape((B,) + ys.shape[2:])
