@@ -28,4 +28,7 @@ def __getattr__(name):
     if name in ("pipeline_apply", "pipeline_stage_params"):
         pl = importlib.import_module(__name__ + ".pipeline")
         return getattr(pl, name)
+    if name in ("switch_moe", "moe_expert_params"):
+        mo = importlib.import_module(__name__ + ".moe")
+        return getattr(mo, name)
     raise AttributeError(name)
