@@ -430,25 +430,34 @@ def interpret_ops(ctx: LoweringContext, ops):
 _COMPANION_SUFFIXES = ("@LENGTHS", "@SUBLENGTHS", "@ARRAY", "@ARRAYLEN")
 
 
+# Ops whose lowering reads ambient env state through OUTPUT-name
+# spellings: while/conditional snapshot their carried vars (listed only as
+# outputs), array writers read-extend `<out>@ARRAY`.  Liveness must keep
+# those names alive across recompute segment boundaries.
+_READS_VIA_OUTPUTS = frozenset(
+    {"while", "conditional_block", "array_write", "write_to_array",
+     "array_read", "array_length", "increment", "assign"}
+)
+
+
 def _ops_read_names(ops):
     """Every env name an op list may read: declared inputs (recursing into
     control-flow sub-blocks, whose bodies read outer names not listed on
-    the parent op) plus the ragged/array companion spellings."""
+    the parent op), output names of ops that read ambient state through
+    their output spelling, plus the ragged/array companion spellings."""
     names = set()
 
     def walk(op):
         for ns in op.inputs.values():
             names.update(ns)
+        if op.type in _READS_VIA_OUTPUTS or getattr(op, "sub_block", None) is not None:
+            for ns in op.outputs.values():
+                names.update(ns)
         # sub-block bodies close over outer env names
         sub = getattr(op, "sub_block", None)
         if sub is not None:
             for o in sub.ops:
                 walk(o)
-        for blk_attr in ("sub_block_2", "else_block"):
-            sub2 = getattr(op, blk_attr, None)
-            if sub2 is not None:
-                for o in sub2.ops:
-                    walk(o)
 
     for op in ops:
         walk(op)
@@ -743,6 +752,10 @@ class Executor:
         key_owner.vars["__rng_key__"] = new_key
         if return_numpy:
             return [np.asarray(v) for v, _ln, _sln in fetches]
+        # return_numpy=False: plain fetches stay DEVICE arrays; fetches
+        # carrying ragged companions come back as host-side LoDArray (the
+        # reference's fetched LoDTensors are host-side too) — that implies
+        # a device->host copy for exactly those fetches.
         out = []
         for v, ln, sln in fetches:
             if ln is not None:
@@ -913,9 +926,11 @@ class Executor:
         def runner(state, feeds, key):
             jitted = cell.get("jit")
             if jitted is None:
+                has_dp = "dp" in mesh.axis_names
                 feed_shardings = {
                     n: NamedSharding(mesh, P("dp"))
-                    if n in data_names and np.ndim(v) >= 1 and np.shape(v)[0] % dp_size == 0
+                    if has_dp and n in data_names and np.ndim(v) >= 1
+                    and np.shape(v)[0] % dp_size == 0
                     else repl
                     for n, v in feeds.items()
                 }

@@ -1400,11 +1400,17 @@ def beam_search_decode(ids, scores, parents, beam_size, end_id, name=None):
     """Backtrace beams into full sentences (reference nn.py:3349 /
     beam_search_decode_op.cc).  ``ids``/``scores``/``parents`` are tensor
     arrays written once per decode step via ``array_write`` (each element
-    ``[batch, beam]``).  Returns ``sentence_ids [batch, beam, T]`` (padded
-    with ``end_id`` past each sentence's finish) and ``sentence_scores
-    [batch, beam]``; the reference's LoD-packed result is replaced by this
-    dense layout (backtrace = one reversed lax.scan on device).
-    """
+    ``[batch, beam]``).
+
+    Returns the reference's 2-level structure in the padded-rows layout:
+    ``sentence_ids [batch*beam, T]`` — one row per hypothesis, padded with
+    ``end_id`` past each sentence's finish, beams grouped per source in
+    row order — and ``sentence_scores [batch*beam]``.  Fetching with
+    ``return_numpy=False`` yields a ``LoDArray`` whose lengths are the
+    per-hypothesis token counts (through the first ``end_id``) and whose
+    sub_lengths group beam rows per source sentence.  Reshape to
+    ``[batch, beam, T]`` with ``ids.reshape(batch, beam, -1)`` when a
+    dense view is wanted (backtrace = one reversed lax.scan on device)."""
     helper = LayerHelper("beam_search_decode", name=name)
     sentence_ids = helper.create_variable_for_type_inference(dtype="int64", stop_gradient=True)
     sentence_scores = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)

@@ -216,7 +216,11 @@ def _sequence_expand(ctx, op):
         xlens = ctx.get_lengths(op.inputs["X"][0])
         if xlens is not None:
             ctx.set_lengths(op.outputs["Out"][0], jnp.take(jnp.asarray(xlens).reshape(-1), gidx))
-        elif x.ndim >= 2:
+        elif x.ndim >= 3:
+            # [rows, T, ...] without lengths: every row is full-length.
+            # A 2-D x is per-row FEATURES ([rows, D] — the module-wide
+            # convention, see the non-nested branch below), so dim 1 must
+            # NOT become a length there.
             ctx.set_lengths(
                 op.outputs["Out"][0],
                 jnp.full((n_rows,), x.shape[1], dtype=jnp.int32))

@@ -49,6 +49,15 @@ def switch_moe(x, gate_w, expert_params, expert_fn, mesh, axis_name="ep",
     B = x.shape[0]
     if B % E:
         raise ValueError("token count %d %% ep size %d != 0" % (B, E))
+    if gate_w.shape[1] != E:
+        # extra gate columns would silently zero every token routed past E
+        raise ValueError(
+            "gate_w has %d expert columns but the %r axis has %d devices"
+            % (gate_w.shape[1], axis_name, E))
+    lead = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+    if lead != E:
+        raise ValueError(
+            "expert_params leading dim %d != ep size %d" % (lead, E))
     t_local = B // E
     C = int(np.ceil(capacity_factor * t_local / E))
 
@@ -61,7 +70,7 @@ def switch_moe(x, gate_w, expert_params, expert_fn, mesh, axis_name="ep",
         check_vma=False,
     )
     def run(xs, gw, params):
-        xs = xs  # [t_local, D]
+        # xs: this shard's tokens [t_local, D]
         my_params = jax.tree_util.tree_map(lambda p: p[0], params)
         logits = xs @ gw                                   # [t, E]
         probs = jax.nn.softmax(logits, axis=-1)

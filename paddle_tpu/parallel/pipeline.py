@@ -76,16 +76,22 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
 
         def tick(carry, t):
             held = carry  # activation this device is about to process
-            # stage 0 ingests microbatch t (zeros once the batch is drained)
-            feed = jnp.where(t < M, xs[jnp.minimum(t, M - 1)], jnp.zeros_like(held))
+            # stage 0 ingests microbatch t; during the drain (t >= M) it
+            # re-feeds the LAST microbatch rather than zeros — the output
+            # is discarded either way, but zeros would let a stage_fn that
+            # is non-finite at 0 (e.g. x/||x||) poison parameter grads via
+            # 0 * NaN in the VJP
+            feed = xs[jnp.minimum(t, M - 1)]
             inp = jnp.where(idx == 0, feed, held)
             out = stage_fn(my_params, inp)
             nxt = jax.lax.ppermute(out, axis_name, perm)
             # the LAST stage's output at tick t is microbatch t-(S-1)
             return nxt, out
 
-        zeros = jnp.zeros_like(xs[0])
-        _, outs = jax.lax.scan(tick, zeros, jnp.arange(T))
+        # initial carry is a REAL microbatch for the same reason as the
+        # drain feed: fill-phase garbage is discarded, but it must stay
+        # finite or it NaN-poisons the VJP
+        _, outs = jax.lax.scan(tick, xs[0], jnp.arange(T))
         # outs[t] on device S-1 is microbatch t-(S-1); select those M slices
         last = outs[S - 1:]
         # only stage S-1 holds the real outputs; psum-broadcast them out

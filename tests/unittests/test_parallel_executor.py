@@ -259,3 +259,31 @@ def test_tp_sharded_step_matches_replicated():
         np.testing.assert_allclose(
             np.asarray(tp_state[n]), np.asarray(ref_state[n]), rtol=1e-4, atol=1e-5, err_msg=n
         )
+
+
+def test_parallel_executor_pure_tp_mesh_without_dp_axis():
+    """A mesh with no 'dp' axis must not try to batch-shard feeds on it
+    (regression: NamedSharding(P('dp')) on a ('tp',) mesh raised)."""
+    main, startup, loss = _build(seed=19)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    X = rng.randn(8, 8).astype("float32")
+    Y = rng.randint(0, 4, size=(8, 1)).astype("int64")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        single = [
+            float(np.ravel(exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])[0])[0])
+            for _ in range(3)
+        ]
+
+    main2, startup2, loss2 = _build(seed=19)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        pexe = fluid.ParallelExecutor(
+            loss_name=loss2.name, main_program=main2, mesh_shape={"tp": 2})
+        got = [
+            float(np.ravel(pexe.run(fetch_list=[loss2], feed={"x": X, "y": Y})[0]).mean())
+            for _ in range(3)
+        ]
+    np.testing.assert_allclose(got, single, rtol=1e-5)

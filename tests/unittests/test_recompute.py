@@ -126,3 +126,41 @@ def test_recompute_under_parallel_executor_mesh():
         w_mesh = np.asarray(fluid.global_scope()["fc_0.w_0"]).copy()
     np.testing.assert_allclose(mesh_losses, plain_losses, rtol=1e-4)
     np.testing.assert_allclose(w_mesh, w_plain, rtol=1e-4, atol=1e-6)
+
+
+def test_recompute_keeps_while_carried_vars_alive():
+    """Liveness regression: a var initialized in an early segment and only
+    WRITTEN (never read via declared inputs) by a later While op must
+    survive the segment-boundary prune."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        # counter initialized at the very top -> first recompute segment
+        counter = fluid.layers.zeros(shape=[1], dtype="int64")
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        h = x
+        for _ in range(4):
+            h = fluid.layers.fc(input=h, size=16, act="relu")
+        # While in a LATE segment increments the counter (output-only var)
+        cond = fluid.layers.less_than(x=counter, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            fluid.layers.increment(x=counter, value=1, in_place=True)
+            fluid.layers.less_than(x=counter, y=limit, cond=cond)
+        p = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    main.enable_recompute(3)
+
+    rng = np.random.RandomState(0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={"x": rng.randn(4, 8).astype("float32"),
+                                    "y": rng.randint(0, 4, (4, 1)).astype("int64")},
+                        fetch_list=[loss])
+        assert np.isfinite(np.ravel(lv)[0])
