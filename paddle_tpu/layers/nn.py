@@ -25,6 +25,7 @@ __all__ = [
     "nce",
     "hsigmoid",
     "flash_attention",
+    "switch_moe",
     "beam_search",
     "beam_search_decode",
     "embedding",
@@ -1667,5 +1668,36 @@ def flash_attention(q, k, v, kv_lens=None, causal=False, sequence_parallel=True,
         outputs={"Out": [out]},
         attrs={"causal": causal, "sequence_parallel": bool(sequence_parallel),
                "sp_engine": sp_engine},
+    )
+    return out
+
+
+def switch_moe(input, num_experts, expert_hidden, capacity_factor=2.0,
+               param_attr=None, name=None):
+    """Switch-style Mixture-of-Experts FFN: top-1 gating over
+    ``num_experts`` relu FFNs of hidden width ``expert_hidden``.
+
+    No reference analog (Fluid v0.15 predates MoE).  Single device: dense
+    top-1 computation.  Under a ``ParallelExecutor`` whose ``mesh_shape``
+    carries an ``ep`` axis equal to ``num_experts``, experts run
+    EXPERT-PARALLEL — one expert per device, tokens shipped by
+    ``all_to_all`` with capacity ``capacity_factor`` and the Switch
+    overflow-drop rule (parallel/moe.py).  Input [batch(, time), d]."""
+    helper = LayerHelper("switch_moe", **locals())
+    d = int(input.shape[-1])
+    gate_w = helper.create_parameter(
+        attr=param_attr, shape=[d, num_experts], dtype=input.dtype)
+    w1 = helper.create_parameter(
+        attr=param_attr, shape=[num_experts, d, expert_hidden], dtype=input.dtype)
+    w2 = helper.create_parameter(
+        attr=param_attr, shape=[num_experts, expert_hidden, d], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                    shape=input.shape)
+    helper.append_op(
+        type="switch_moe",
+        inputs={"X": [input], "GateW": [gate_w], "ExpertW1": [w1],
+                "ExpertW2": [w2]},
+        outputs={"Out": [out]},
+        attrs={"capacity_factor": float(capacity_factor)},
     )
     return out

@@ -1,0 +1,57 @@
+"""Switch-MoE op lowering (first-class ep through the Program API).
+
+No reference analog (Fluid v0.15 predates MoE).  ``layers.switch_moe``
+appends one op holding the gate + stacked expert FFN parameters; this
+lowering runs the dense reference computation on a single device and the
+expert-parallel all-to-all engine (parallel/moe.py) when the executor
+mesh carries a non-trivial ``ep`` axis whose size matches the expert
+count — the mesh IS the opt-in, mirroring flash_attention's sp rule.
+"""
+from __future__ import annotations
+
+from ..registry import register
+
+
+@register("switch_moe")
+def _switch_moe(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")            # [B, D] or [B, T, D]
+    gate_w = ctx.get_input(op, "GateW")   # [D, E]
+    w1 = ctx.get_input(op, "ExpertW1")    # [E, D, H]
+    w2 = ctx.get_input(op, "ExpertW2")    # [E, H, D]
+    cap = float(op.attrs.get("capacity_factor", 2.0))
+    E = w1.shape[0]
+
+    lead = x.shape[:-1]
+    D = x.shape[-1]
+    xt = x.reshape(-1, D)
+    B = xt.shape[0]
+
+    def expert_fn(p, toks):
+        return jax.nn.relu(toks @ p["w1"]) @ p["w2"]
+
+    mesh = ctx.mesh
+    ep = 0
+    if mesh is not None:
+        ep = int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("ep", 0))
+    if ep > 1 and ep == E and B % ep == 0:
+        from ..parallel.moe import switch_moe as moe_engine
+
+        out = moe_engine(xt, gate_w, {"w1": w1, "w2": w2}, expert_fn, mesh,
+                         axis_name="ep", capacity_factor=cap)
+        ctx.set_output(op, "Out", out.reshape(lead + (D,)))
+        return
+
+    # dense single-device reference: every expert on every token, top-1
+    # combine (identical numerics to the engine with ample capacity)
+    probs = jax.nn.softmax(xt @ gate_w, axis=-1)       # [B, E]
+    choice = jnp.argmax(probs, axis=-1)                # [B]
+    gate = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+    all_out = jnp.einsum(
+        "ebh,ehd->ebd",
+        jax.nn.relu(jnp.einsum("bd,edh->ebh", xt, w1)), w2)  # [E, B, D]
+    picked = jnp.take_along_axis(
+        all_out, choice[None, :, None], axis=0)[0]     # [B, D]
+    ctx.set_output(op, "Out", (picked * gate[:, None]).reshape(lead + (D,)))
