@@ -132,6 +132,16 @@ def read_fluid_tensor(f):
 
 def write_fluid_tensor(f, arr, lod=None):
     arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _DTYPE_IDS:
+        # bf16 (this repo's on-TPU state) has no reference VarType id —
+        # export the f32 view; other unmapped dtypes fail loudly.  (Name
+        # check: ml_dtypes' bfloat16 is not an np.floating subdtype.)
+        if arr.dtype.name == "bfloat16" or np.issubdtype(arr.dtype, np.floating):
+            arr = np.ascontiguousarray(arr.astype(np.float32))
+        else:
+            raise ValueError(
+                "dtype %s has no reference VarType id (supported: %s)"
+                % (arr.dtype, sorted(str(d) for d in _DTYPE_IDS)))
     f.write(struct.pack("<I", 0))
     lod = lod or []
     f.write(struct.pack("<Q", len(lod)))
@@ -170,18 +180,33 @@ def read_fluid_combined(path, names):
     return out
 
 
+def _looks_like_fluid_tensor(path):
+    """Cheap sniff: the first 4 bytes are the u32 version and must be 0.
+    Distinguishes 'not a tensor file at all' (skip) from 'a tensor file
+    that fails mid-read' (raise — silent skips would hand back a
+    partially loaded model)."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(4)
+    except OSError:
+        return False
+    return len(head) == 4 and struct.unpack("<I", head)[0] == 0
+
+
 def load_fluid_persistables(dirname, scope=None, names=None):
     """Load a reference ``save_persistables`` directory (one binary file
-    per variable) into ``scope`` (or a returned dict)."""
+    per variable) into ``scope`` (or a returned dict).  Raises IOError on
+    a truncated/corrupt tensor file instead of silently dropping the
+    parameter."""
     out = {}
     for name in (names if names is not None else sorted(os.listdir(dirname))):
         path = os.path.join(dirname, name)
-        if not os.path.isfile(path):
+        if not os.path.isfile(path) or not _looks_like_fluid_tensor(path):
             continue
         try:
             arr, _lod = read_fluid_var_file(path)
-        except (ValueError, struct.error):
-            continue  # not a fluid tensor file (e.g. a meta file)
+        except (ValueError, struct.error) as e:
+            raise IOError("corrupt fluid tensor file %r: %s" % (path, e))
         out[name] = arr
         if scope is not None:
             scope[name] = arr

@@ -38,11 +38,19 @@ def save_sharded(dirname, state, step=0):
 
     path = os.path.abspath(os.path.join(dirname, "step_%d" % int(step)))
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    # orbax refuses to overwrite; mirror trainer.py's serial semantics
+    # orbax refuses to overwrite; mirror trainer.py's serial semantics.
+    # Multi-host: ONLY process 0 removes (N hosts racing rmtree on one
+    # shared path crash on each other's deletions), and everyone barriers
+    # before Orbax starts writing into the fresh directory.
     if os.path.exists(path):
         import shutil
 
-        shutil.rmtree(path)
+        if jax.process_index() == 0:
+            shutil.rmtree(path)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("paddle_tpu_ckpt_rm")
     arrays = {k: v if hasattr(v, "dtype") else np.asarray(v) for k, v in state.items()}
     _checkpointer().save(path, arrays)
     return path

@@ -113,8 +113,14 @@ def _parse_hlo_op_rows(hlo_text, known_op_types):
     import re
 
     rows = defaultdict(lambda: {"instructions": 0, "out_bytes": 0})
-    shape_re = re.compile(r"=\s+([a-z0-9]+)\[([0-9,]*)\]")
+    # every result-type token after '=' — tuple-shaped results list each
+    # element, so all of them count toward out_bytes
+    shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
     meta_re = re.compile(r'metadata=\{op_name="([^"]+)"')
+    # fusion CALL lines carry the fused root's metadata; the body
+    # instructions inside %fused_computation carry their own — counting
+    # both double-counts the root
+    fusion_call_re = re.compile(r"=\s+\(?[a-z0-9]+\[[^=]*\bfusion\(")
     # autodiff/transform tracing wraps scope names: the forward replay under
     # value_and_grad shows as jvp(<op>), its backward as transpose(jvp(<op>))
     wrapper_re = re.compile(r"^(?:jvp|transpose|jit|vmap|remat|custom_jvp|custom_vjp)\((.*)\)$")
@@ -122,6 +128,8 @@ def _parse_hlo_op_rows(hlo_text, known_op_types):
         m = meta_re.search(line)
         if not m:
             continue
+        if fusion_call_re.search(line):
+            continue  # body instructions account for this fusion
         op_name = m.group(1)
         segs = op_name.split("/")
         op_type = None
@@ -139,15 +147,19 @@ def _parse_hlo_op_rows(hlo_text, known_op_types):
             continue
         if "transpose(" in op_name:
             op_type += "_grad"
-        sm = shape_re.search(line)
+        # result types sit between '=' and the HLO opcode's '('; operands
+        # appear as %names without types, so every shape token on that
+        # span belongs to the result (tuples list one per element)
+        eq = line.find("=")
+        paren = line.find("(", eq)
+        span = line[eq: paren if paren != -1 else len(line)]
         nbytes = 0
-        if sm:
-            dt, dims = sm.group(1), sm.group(2)
+        for dt, dims in shape_re.findall(span):
             n = 1
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            nbytes = n * _DTYPE_BYTES.get(dt, 4)
+            nbytes += n * _DTYPE_BYTES.get(dt, 4)
         rows[op_type]["instructions"] += 1
         rows[op_type]["out_bytes"] += nbytes
     return dict(rows)

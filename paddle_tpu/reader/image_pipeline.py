@@ -236,6 +236,10 @@ def decoded_pipeline(files, mode="train", image_size=224, num_workers=2,
             arr = np.frombuffer(rec, np.uint8, h * w * 3, 8).reshape(h, w, 3)
             gen = np.random.default_rng([seed, i])
             s = image_size
+            if h < s or w < s:
+                raise ValueError(
+                    "stored image %dx%d smaller than image_size %d — "
+                    "re-convert with stored_size >= image_size" % (h, w, s))
             if mode == "train":
                 y0 = int(gen.integers(0, h - s + 1)) if h > s else 0
                 x0 = int(gen.integers(0, w - s + 1)) if w > s else 0
@@ -294,7 +298,8 @@ def flowers_records(path_prefix, num_shards=4, data_dir=None, synth_n=256):
 
 def _record_source(files, num_threads, capacity, shuffle_buf, seed, epochs):
     """Yield raw records from the C++ threaded loader, falling back to a
-    python round-robin scan of the shards."""
+    python scan of the shards with the SAME shuffle semantics (shard order
+    + a shuffle buffer, both seeded per epoch)."""
     from ..native import lib as native_lib
 
     if native_lib() is not None:
@@ -310,9 +315,28 @@ def _record_source(files, num_threads, capacity, shuffle_buf, seed, epochs):
         return
     from ..recordio_io import PyReader
 
-    for _ in range(epochs):
-        for f in files:
-            yield from PyReader(f)
+    for epoch in range(epochs):
+        rng = np.random.default_rng([seed, epoch])
+        order = list(files)
+        if shuffle_buf:
+            rng.shuffle(order)
+
+        def scan():
+            for f in order:
+                yield from PyReader(f)
+
+        if not shuffle_buf:
+            yield from scan()
+            continue
+        buf = []
+        for rec in scan():
+            buf.append(rec)
+            if len(buf) >= shuffle_buf:
+                j = int(rng.integers(0, len(buf)))
+                buf[j], buf[-1] = buf[-1], buf[j]
+                yield buf.pop()
+        rng.shuffle(buf)
+        yield from buf
 
 
 def image_pipeline(files, mode="train", image_size=224, num_workers=8,
@@ -323,8 +347,11 @@ def image_pipeline(files, mode="train", image_size=224, num_workers=8,
     A C++ loader thread pool scans/shuffles the shards; ``num_workers``
     python threads decode+augment concurrently (PIL's codec paths drop the
     GIL) into a bounded queue, so downstream sees a steady stream of ready
-    tensors.  Per-sample determinism: sample i of epoch e uses
-    ``default_rng((seed, e, i))`` no matter which worker runs it.
+    tensors.  Deterministic for a fixed seed: record i of the source
+    stream (a cross-epoch index, so epochs draw fresh augmentations) uses
+    ``default_rng((seed, i))`` no matter which worker runs it, and samples
+    are emitted in source order (out-of-order worker completions are
+    re-sequenced).
     """
 
     def reader():
@@ -341,46 +368,77 @@ def image_pipeline(files, mode="train", image_size=224, num_workers=8,
             try:
                 for i, rec in enumerate(src_iter):
                     in_q.put((i, rec))
+            except BaseException as e:  # noqa: BLE001
+                worker_error.append(e)
+                raise
             finally:
                 for _ in range(num_workers):
                     in_q.put(STOP)
 
         skipped = [0]
         emitted = [0]
+        worker_error = []
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is STOP:
-                    out_q.put(STOP)
-                    return
-                i, rec = item
-                (label,) = struct.unpack_from("<I", rec, 0)
-                gen = np.random.default_rng([seed, i])
-                try:
-                    img = process_image(rec[4:], mode, image_size, gen,
-                                        color_jitter, output)
-                except (OSError, ValueError, struct.error):
-                    # corrupt record: skip, as the reference does.  Catching
-                    # narrowly (codec/format errors only) keeps systemic
-                    # failures (missing PIL, wrong record schema) loud.
-                    skipped[0] += 1
-                    continue
-                emitted[0] += 1
-                out_q.put((i, img, np.int64(label)))
+            # the finally ALWAYS emits this worker's STOP: a dying worker
+            # must never leave the consumer blocked on out_q.get() forever
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is STOP:
+                        return
+                    i, rec = item
+                    try:
+                        (label,) = struct.unpack_from("<I", rec, 0)
+                        img = process_image(rec[4:], mode, image_size,
+                                            np.random.default_rng([seed, i]),
+                                            color_jitter, output)
+                    except (OSError, ValueError, struct.error):
+                        # corrupt record: skip, as the reference does —
+                        # but tell the consumer so index-ordered emission
+                        # can advance past the hole
+                        skipped[0] += 1
+                        out_q.put((i, None, None))
+                        continue
+                    emitted[0] += 1
+                    out_q.put((i, img, np.int64(label)))
+            except BaseException as e:  # noqa: BLE001
+                worker_error.append(e)
+                raise
+            finally:
+                out_q.put(STOP)
 
         threads = [threading.Thread(target=feed, daemon=True)]
         threads += [threading.Thread(target=work, daemon=True) for _ in range(num_workers)]
         for t in threads:
             t.start()
+        # index-ordered emission: workers finish out of order, so hold
+        # early arrivals until their predecessors land — the stream is
+        # then deterministic for a fixed seed regardless of worker count
+        # or thread scheduling.  Held items are bounded by the queue
+        # capacities, not the dataset size.
         finished = 0
+        next_idx = 0
+        held: dict = {}
         while finished < num_workers:
             item = out_q.get()
             if item is STOP:
                 finished += 1
                 continue
-            _i, img, label = item
-            yield img, label
+            i, img, label = item
+            held[i] = (img, label)
+            while next_idx in held:
+                img2, label2 = held.pop(next_idx)
+                next_idx += 1
+                if img2 is not None:  # None = skipped (corrupt) record
+                    yield img2, label2
+        for i in sorted(held):
+            img2, label2 = held[i]
+            if img2 is not None:
+                yield img2, label2
+        if worker_error:
+            raise IOError(
+                "image pipeline worker died: %r" % (worker_error[0],))
         if skipped[0] and not emitted[0]:
             raise IOError(
                 "image pipeline decoded 0 of %d records — the shards are "
