@@ -160,33 +160,39 @@ def main():
 
     next_feed = lambda: feeds  # noqa: E731
     if args.real_data:
-        if args.model not in ("mnist", "vgg16", "resnet50", "se_resnext"):
+        # each image model's true input contract: (feed name, CHW shape,
+        # class count) straight from its data layer / get_model defaults
+        contracts = {
+            "mnist": ("pixel", (1, 28, 28), 10),
+            "vgg16": ("pixel", (3, 32, 32), 10),
+            "resnet50": ("data", (3, 224, 224), 1000),
+            "se_resnext": ("data", (3, 224, 224), 1000),
+        }
+        if args.model not in contracts:
             raise SystemExit("--real_data supports image models only")
+        img_key, shape, n_classes = contracts[args.model]
         import tempfile
 
         from paddle_tpu.reader.image_pipeline import (
             batched_images, convert_decoded_to_recordio, decoded_pipeline,
             synthesize_jpeg_corpus, normalize_batch)
 
-        shape = model.get("image_shape", (3, 224, 224))
         size = shape[1]
         d = tempfile.mkdtemp(prefix="fb_real_")
         samples = synthesize_jpeg_corpus(d, n=max(256, 2 * batch),
-                                         size=size + 32, classes=1000)
+                                         size=size + 32, classes=n_classes)
         shards = convert_decoded_to_recordio(
             samples, os.path.join(d, "dec"), stored_size=size + 32)
         reader = decoded_pipeline(shards, mode="train", image_size=size,
                                   epochs=10_000, output="uint8")
         batches = batched_images(reader, batch)()
-        img_key = "pixel" if args.model == "mnist" else "data"
 
         def next_feed():
             imgs, labels = next(batches)
             x = normalize_batch(imgs)
-            if args.model == "mnist":  # grayscale 28x28 model
-                x = x[:, :1, :28, :28]
-            lab = labels % (10 if args.model == "mnist" else 1000)
-            return {img_key: x.astype("float32"), "label": lab}
+            if shape[0] == 1:  # grayscale model: luminance channel
+                x = x.mean(axis=1, keepdims=True)
+            return {img_key: x.astype("float32"), "label": labels % n_classes}
 
     from paddle_tpu.executor import Executor
 

@@ -115,16 +115,21 @@ def _reader_creator(split, size, word_idx=None):
 
     def reader():
         nonlocal encoded
+        # serve the encoded cache FIRST: after purge_cache() freed the
+        # token corpus, later epochs must not re-stream the whole tarball
+        # just to rebuild state this reader already has
+        if encoded is not None:
+            yield from encoded
+            return
         real = _load_real()
         if real is not None:
-            if encoded is None:
-                wi = word_idx or build_dict()
-                unk = wi.get("<unk>", len(wi) - 1)
-                encoded = [
-                    ([wi.get(t, unk) for t in toks], label)
-                    for label, dkey in ((0, split + "/pos"), (1, split + "/neg"))
-                    for toks in real["docs"][dkey]
-                ]
+            wi = word_idx or build_dict()
+            unk = wi.get("<unk>", len(wi) - 1)
+            encoded = [
+                ([wi.get(t, unk) for t in toks], label)
+                for label, dkey in ((0, split + "/pos"), (1, split + "/neg"))
+                for toks in real["docs"][dkey]
+            ]
             yield from encoded
             return
         r = rng_for("imdb", split)

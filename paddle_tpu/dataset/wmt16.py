@@ -50,7 +50,7 @@ def _build_dict(tar, dict_size, lang):
                 for w in parts[col].split():
                     freq[w] += 1
         ranked = sorted(freq.items(), key=lambda kv: -kv[1])
-        with open(path, "w") as f:
+        with open(path, "w", encoding="utf-8") as f:
             f.write("%s\n%s\n%s\n" % (START, END, UNK))
             for w, _ in ranked[: dict_size - 3]:
                 f.write("%s\n" % w)

@@ -129,8 +129,9 @@ class DetectionMAP(Evaluator):
     counts across batches.  Padded-contract inputs (see
     layers/detection.py detection_map): ``input`` [B, K, 6],
     ``gt_box`` [B, G, 4], ``gt_label`` [B, G] (+ lengths via LoDArray).
-    ``gt_difficult`` rows are EXCLUDED from the gt count when
-    ``evaluate_difficult=False`` by masking their label to background.
+    With ``evaluate_difficult=False``, difficult gt follow the reference
+    rule: excluded from the positive count, and detections matched to one
+    are neutral (neither TP nor FP).
     """
 
     def __init__(self, input, gt_label, gt_box, gt_difficult=None,
@@ -139,31 +140,23 @@ class DetectionMAP(Evaluator):
                  state_capacity=512):
         super().__init__("map_eval")
         from .layers import detection as det_layers
-        from .layers import nn, tensor as tl
+        from .layers import tensor as tl
 
         if class_num is None:
             raise ValueError("DetectionMAP needs class_num")
         label = gt_label
-        if gt_difficult is not None and not evaluate_difficult:
-            # difficult gt must count neither as positives nor toward npos:
-            # folding them into the background class removes both.
-            # label' = label*(1-diff) + background*diff, diff in {0, 1}
-            diff = tl.cast(gt_difficult, "float32")
-            if len(diff.shape) == 3:
-                diff = nn.squeeze(diff, axes=[2])
-            keep = nn.scale(diff, scale=-1.0, bias=1.0)
-            label = tl.cast(
-                nn.elementwise_add(
-                    x=nn.elementwise_mul(x=tl.cast(label, "float32"), y=keep),
-                    y=nn.scale(diff, scale=float(background_label))),
-                "int64")
+        # difficult gt ride the op's native path (reference rule: excluded
+        # from npos, matched detections neutral — NOT false positives)
+        diff_kwargs = dict(gt_difficult=gt_difficult,
+                           evaluate_difficult=evaluate_difficult)
 
         # current-minibatch mAP (stateless)
         self.cur_map, _, _, _ = det_layers.detection_map(
             input, gt_box, label, class_num,
             background_label=background_label,
             overlap_threshold=overlap_threshold,
-            ap_version=ap_version, state_capacity=state_capacity)
+            ap_version=ap_version, state_capacity=state_capacity,
+            **diff_kwargs)
 
         # accumulative mAP: accumulator outputs ARE the persistable states
         pc = self._create_state(dtype="int32", shape=[class_num, 1],
@@ -178,7 +171,8 @@ class DetectionMAP(Evaluator):
             background_label=background_label,
             overlap_threshold=overlap_threshold,
             input_states=(pc, tp, fp),
-            ap_version=ap_version, state_capacity=state_capacity)
+            ap_version=ap_version, state_capacity=state_capacity,
+            **diff_kwargs)
         tl.assign(pc_out, output=pc)
         tl.assign(tp_out, output=tp)
         tl.assign(fp_out, output=fp)

@@ -412,11 +412,17 @@ def roi_perspective_transform(input, rois, transformed_height, transformed_width
 def detection_map(detect_res, label_boxes, label_classes, class_num,
                   background_label=0, overlap_threshold=0.3,
                   input_states=None, ap_version="integral",
-                  state_capacity=512, name=None):
+                  state_capacity=512, gt_difficult=None,
+                  evaluate_difficult=True, name=None):
     """Accumulative in-graph mAP (reference detection.py:399).  The padded
     analog of the reference LoD contract: ``detect_res`` [batch, K, 6]
     (label, score, x0, y0, x1, y1; invalid rows -1), ground truth as
     separate boxes [batch, G, 4] + classes [batch, G].
+
+    With ``evaluate_difficult=False`` and a ``gt_difficult`` [batch, G]
+    mask, difficult ground truth follows the reference rule: excluded
+    from the positive count, and detections matched to one are NEUTRAL
+    (neither TP nor FP).
 
     Returns (map_out, accum_pos_count, accum_true_pos, accum_false_pos);
     feed the three accum states back through ``input_states`` to pool the
@@ -429,6 +435,8 @@ def detection_map(detect_res, label_boxes, label_classes, class_num,
     fp = helper.create_variable_for_type_inference(dtype="float32")
     inputs = {"DetectRes": [detect_res], "GtBoxes": [label_boxes],
               "GtLabels": [label_classes]}
+    if gt_difficult is not None:
+        inputs["GtDifficult"] = [gt_difficult]
     if input_states is not None:
         inputs["PosCount"] = [input_states[0]]
         inputs["TruePos"] = [input_states[1]]
@@ -440,7 +448,8 @@ def detection_map(detect_res, label_boxes, label_classes, class_num,
                  "AccumTruePos": [tp], "AccumFalsePos": [fp]},
         attrs={"class_num": class_num, "background_label": background_label,
                "overlap_threshold": overlap_threshold, "ap_type": ap_version,
-               "state_capacity": state_capacity},
+               "state_capacity": state_capacity,
+               "evaluate_difficult": bool(evaluate_difficult)},
     )
     for v in (map_out, pc, tp, fp):
         v.stop_gradient = True

@@ -138,9 +138,15 @@ def create_lod_array(data, recursive_seq_lens=None, place=None) -> LoDArray:
     if isinstance(data, LoDArray):
         return data
     if isinstance(data, (list, tuple)) and recursive_seq_lens is None:
-        # list of per-sequence arrays, or list of groups of per-sequence
-        # arrays (nested): [[seq, seq], [seq]] -> 2-level
-        if data and isinstance(data[0], (list, tuple)):
+        # list of per-sequence arrays, or list of GROUPS of per-sequence
+        # arrays (nested): [[seq, seq], [seq]] -> 2-level.  A group's
+        # elements must themselves be sequences (array-likes of rank >= 1);
+        # a plain list of scalars like [1, 2, 3] is ONE 1-level sequence.
+        def _is_group(g):
+            return (isinstance(g, (list, tuple)) and len(g) > 0
+                    and all(np.ndim(s) >= 1 for s in g))
+
+        if data and all(_is_group(g) for g in data):
             counts = np.array([len(g) for g in data], np.int32)
             flat = [np.asarray(s) for g in data for s in g]
             out = pack_sequences(flat)

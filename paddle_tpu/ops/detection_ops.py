@@ -857,7 +857,20 @@ def _detection_map(ctx, op):
 
     gmask = jnp.arange(G)[None, :] < gt_lens[:, None]          # [B, G]
 
-    def match_image(db, gb, gl, gm):
+    # difficult handling (reference detection_map_op.h with
+    # evaluate_difficult=false): difficult gt never count toward npos, and
+    # a detection matched to one is NEUTRAL — neither TP nor FP (it still
+    # claims the gt so it absorbs the detection).
+    gt_diff = ctx.get_input(op, "GtDifficult", None)           # [B, G] 0/1
+    eval_diff = bool(a.get("evaluate_difficult", True))
+    if gt_diff is not None and gt_diff.ndim == 3:
+        gt_diff = gt_diff[..., 0]
+    if gt_diff is None or eval_diff:
+        diff_mask = jnp.zeros((B, G), bool)
+    else:
+        diff_mask = gt_diff.astype(bool) & gmask
+
+    def match_image(db, gb, gl, gm, gd):
         """Greedy match this image's detections (score desc) to its gt."""
         scores = jnp.where(db[:, 0] >= 0, db[:, 1], -jnp.inf)
         order = jnp.argsort(-scores)
@@ -865,23 +878,27 @@ def _detection_map(ctx, op):
         iou = _iou(ds[:, 2:6], gb)                             # [K, G]
 
         def body(i, carry):
-            claimed, tp = carry
+            claimed, tp, neutral = carry
             lab = ds[i, 0].astype(jnp.int32)
             cand = gm & (gl.astype(jnp.int32) == lab)
             ious = jnp.where(cand, iou[i], -1.0)
             j = ious.argmax()
             hit = (ious[j] >= ov_t) & ~claimed[j] & (ds[i, 0] >= 0)
             claimed = claimed.at[j].set(claimed[j] | hit)
-            return claimed, tp.at[i].set(hit)
+            return (claimed, tp.at[i].set(hit & ~gd[j]),
+                    neutral.at[i].set(hit & gd[j]))
 
-        _, tp = jax.lax.fori_loop(
-            0, K, body, (jnp.zeros(G, bool), jnp.zeros(K, bool)))
-        return ds, tp
+        _, tp, neutral = jax.lax.fori_loop(
+            0, K, body,
+            (jnp.zeros(G, bool), jnp.zeros(K, bool), jnp.zeros(K, bool)))
+        return ds, tp, neutral
 
-    ds_all, tp_all = jax.vmap(match_image)(det, gt_boxes, gt_labels, gmask)
+    ds_all, tp_all, neutral_all = jax.vmap(match_image)(
+        det, gt_boxes, gt_labels, gmask, diff_mask)
     ds_flat = ds_all.reshape(B * K, 6)
     tp_flat = tp_all.reshape(B * K)
-    valid_flat = ds_flat[:, 0] >= 0
+    neutral_flat = neutral_all.reshape(B * K)
+    valid_flat = (ds_flat[:, 0] >= 0) & ~neutral_flat
 
     # per-class state update and AP, vmapped over the class axis (a Python
     # loop would unroll the argsort/cumsum blocks class_num times into the
@@ -893,7 +910,7 @@ def _detection_map(ctx, op):
 
     def update_class(c, pc, tpbuf, fpbuf):
         in_c = valid_flat & (det_cls == c)
-        npos = (gmask & (gt_cls == c)).sum()
+        npos = (gmask & ~diff_mask & (gt_cls == c)).sum()
         tp_entry = jnp.stack(
             [jnp.where(in_c & tp_flat, sc, -1.0), jnp.ones(B * K)], axis=1)
         fp_entry = jnp.stack(

@@ -223,6 +223,13 @@ class RegistryClient:
     def register(self, key, value, ttl=5.0, keepalive=True):
         """Register under a lease; a daemon thread renews every ttl/3 until
         ``unregister`` (the etcd lease+keepalive pattern)."""
+        # re-registering a key this client already renews must retire the
+        # old renew thread first, or the two threads fight over the lease
+        # (each 'expired' renewal re-registering yet another lease) and the
+        # key can never be cleanly removed
+        old = self._keepalives.pop(key, None)
+        if old is not None:
+            old[0].set()
         status, lease = self._call("register", (key, value, ttl))
         if keepalive and ttl is not None:
             stop = threading.Event()
@@ -231,11 +238,11 @@ class RegistryClient:
                 while not stop.wait(ttl / 3.0):
                     try:
                         st, _ = self._call("keepalive", (key, lease, ttl))
-                        if st == "expired":
-                            # lease lost (e.g. long GC pause): re-register
-                            # and ADOPT the new lease id, or every later
-                            # keepalive would keep failing against the
-                            # dead one
+                        # lease lost (e.g. long GC pause): re-register and
+                        # ADOPT the new lease id — but never after stop:
+                        # an in-flight 'expired' racing unregister() would
+                        # resurrect the deleted key
+                        if st == "expired" and not stop.is_set():
                             _, lease = self._call("register", (key, value, ttl))
                     except (OSError, IOError):
                         pass  # registry briefly down; retry next tick

@@ -123,6 +123,7 @@ class DistributeTranspiler:
                 "endpoints": self.pserver_endpoints,
                 "sync_mode": self.sync_mode,
                 "slices": grad_slices,
+                "trainer_id": self.trainer_id,
                 "op_role": OpRole.RPC,
             },
         )

@@ -65,9 +65,13 @@ class PSClient:
                     raise
                 time.sleep(0.2)
 
-    def push_pull(self, grads: dict) -> dict:
-        """Send grads, barrier on the sync round, receive fresh params."""
-        _send_msg(self.sock, ("push_pull", grads))
+    def push_pull(self, grads: dict, trainer_id=0, round_id=None) -> dict:
+        """Send grads, barrier on the sync round, receive fresh params.
+
+        ``(trainer_id, round_id)`` make the round EXACTLY-ONCE across
+        reconnects: the server remembers each trainer's last applied round
+        and treats a resend (retry after a torn connection) as a pull."""
+        _send_msg(self.sock, ("push_pull", (grads, trainer_id, round_id)))
         reply = _recv_msg(self.sock)
         if reply is None:
             raise IOError("pserver closed connection")
@@ -91,7 +95,11 @@ class PSClient:
 
 
 class _SyncRound:
-    """Barrier accumulator for one optimizer application."""
+    """Barrier accumulator for one optimizer application.
+
+    Exactly-once per (trainer, round): a retry after a torn connection
+    must not double-count its gradients — duplicates just wait for (or
+    observe) the round's completion."""
 
     def __init__(self, fanin):
         self.fanin = fanin
@@ -100,16 +108,29 @@ class _SyncRound:
         self.grads = {}
         self.count = 0
         self.generation = 0
+        self.contributed: dict = {}  # trainer_id -> last round_id counted
+        self.applied: dict = {}      # trainer_id -> last round_id applied
 
-    def submit(self, grads, apply_fn):
+    def submit(self, grads, apply_fn, trainer_id=0, round_id=None):
         """Add one trainer's grads; the last arrival applies the optimizer.
         Returns after the round's params are fresh."""
         with self.cond:
+            if round_id is not None and self.applied.get(trainer_id) == round_id:
+                return  # retry of a completed round: pure pull
             gen = self.generation
-            for k, v in grads.items():
-                self.grads[k] = self.grads.get(k, 0) + np.asarray(v)
-            self.count += 1
+            duplicate = (round_id is not None
+                         and self.contributed.get(trainer_id) == round_id)
+            if not duplicate:
+                for k, v in grads.items():
+                    self.grads[k] = self.grads.get(k, 0) + np.asarray(v)
+                self.count += 1
+                self.contributed[trainer_id] = round_id
             if self.count == self.fanin:
+                # mark applied BEFORE the apply: apply_fn snapshots the
+                # post-apply params, and that snapshot must carry this
+                # round in the dedup map or a crash-right-after-save +
+                # retry would re-apply it
+                self.applied.update(self.contributed)
                 # average over trainers: each sends mean-loss grads for its
                 # own shard of the global batch, so the sync step must apply
                 # sum/fanin or the effective LR scales with the trainer
@@ -152,13 +173,30 @@ def serve(executor, program, scope):
     # rounds, plus an unconditional save on graceful shutdown below
     ckpt_interval = int(ls.attrs.get("checkpoint_interval", 8) or 1)
     rounds_done = [0]
+    round_ = _SyncRound(fanin)
+
+    # every persistable of the pserver program is checkpointed — restoring
+    # params alone would silently reset Adam moments / momentum / LR
+    # counters on restart
+    ckpt_names = sorted({v.name for v in program.list_vars() if v.persistable})
 
     if ckpt_dir:
         path = _os.path.join(ckpt_dir, "pserver_params.npz")
         if _os.path.exists(path):
             loaded = np.load(path)
             for name in loaded.files:
+                if name == "__applied_tid__":
+                    continue
+                if name == "__applied_round__":
+                    continue
                 scope.vars[name] = loaded[name]
+            # restore the exactly-once dedup map so a retry of the round
+            # whose apply the snapshot captured is NOT applied again
+            if "__applied_tid__" in loaded.files:
+                for tid, rid in zip(loaded["__applied_tid__"],
+                                    loaded["__applied_round__"]):
+                    round_.applied[int(tid)] = int(rid)
+                    round_.contributed[int(tid)] = int(rid)
 
     def _save_checkpoint(force=False):
         if not ckpt_dir:
@@ -168,8 +206,13 @@ def serve(executor, program, scope):
         _os.makedirs(ckpt_dir, exist_ok=True)
         path = _os.path.join(ckpt_dir, "pserver_params.npz")
         tmp = path + ".tmp.npz"
-        arrays = {p: np.asarray(scope.vars[p]) for p in param_names
+        arrays = {p: np.asarray(scope.vars[p]) for p in ckpt_names
                   if scope.vars.get(p) is not None}
+        applied = {t: r for t, r in round_.applied.items() if r is not None}
+        if applied:
+            arrays["__applied_tid__"] = np.array(sorted(applied), np.int64)
+            arrays["__applied_round__"] = np.array(
+                [applied[t] for t in sorted(applied)], np.int64)
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         _os.replace(tmp, path)
@@ -201,7 +244,6 @@ def serve(executor, program, scope):
         rounds_done[0] += 1
         _save_checkpoint()
 
-    round_ = _SyncRound(fanin)
     stop = threading.Event()
 
     host, port = endpoint.rsplit(":", 1)
@@ -218,8 +260,13 @@ def serve(executor, program, scope):
                     return
                 cmd, payload = msg
                 if cmd == "push_pull":
-                    grads = {g: payload[g] for g in grad_names if g in payload}
-                    round_.submit(grads, apply_fn)
+                    # payload: legacy {grads} or (grads, trainer_id, round_id)
+                    if isinstance(payload, tuple):
+                        raw, trainer_id, round_id = payload
+                    else:
+                        raw, trainer_id, round_id = payload, 0, None
+                    grads = {g: raw[g] for g in grad_names if g in raw}
+                    round_.submit(grads, apply_fn, trainer_id, round_id)
                     params = {p: np.asarray(scope.vars[p]) for p in param_names}
                     _send_msg(conn, params)
                 elif cmd == "pull":
@@ -297,15 +344,21 @@ def run_trainer_step(executor, program, feed, fetch_list, scope, clients):
         for sname, ep, r0, r1 in slices:
             part = v if sname == g else np.asarray(v)[r0:r1]
             by_ep.setdefault(ep, {})[sname] = part
+    # per-program monotonically increasing round id: with the trainer_id
+    # below it makes each sync round exactly-once server-side, so a retry
+    # after a torn connection can never double-apply gradients
+    round_id = getattr(program, "_ps_round", 0)
+    program._ps_round = round_id + 1
+    trainer_id = int(send_op.attrs.get("trainer_id", 0))
+
     fresh_all = {}
     for ep, grads in by_ep.items():
-        # fault tolerance: a pserver restart drops the TCP connection; the
-        # round is idempotent server-side (grads not yet applied on a torn
-        # round: the barrier never completed), so reconnect — PSClient's
-        # constructor waits for the endpoint to come back — and resend.
+        # fault tolerance: a pserver restart drops the TCP connection;
+        # reconnect — PSClient's constructor waits for the endpoint to
+        # come back — and resend; the server dedups by (trainer, round).
         for attempt in range(3):
             try:
-                fresh_all.update(clients[ep].push_pull(grads))
+                fresh_all.update(clients[ep].push_pull(grads, trainer_id, round_id))
                 break
             except (IOError, OSError):
                 if attempt == 2:

@@ -269,3 +269,42 @@ def test_detection_map_evaluator_accumulates_across_batches():
         ev.reset(exe)
         _, am = exe.run(main, feed=feeds[0], fetch_list=[cur_map, accum_map])
         np.testing.assert_allclose(float(np.ravel(am)[0]), accums[0], rtol=1e-5)
+
+
+def test_detection_map_difficult_neutral_rule():
+    """evaluate_difficult=False (reference detection_map_op.h): difficult
+    gt leave npos, and a detection matched to one is NEITHER TP nor FP."""
+    import paddle_tpu as fluid
+    from paddle_tpu.lod import LoDArray
+
+    K = 2
+    # det 0 overlaps the DIFFICULT gt (neutral); det 1 overlaps the normal one
+    det = np.array([[[1, 0.9, 0, 0, 1, 1], [1, 0.8, 4, 4, 5, 5]]], "float32")
+    gtb = np.array([[[0, 0, 1, 1], [4, 4, 5, 5]]], "float32")
+    gtl = np.array([[1, 1]], "int64")
+    diff = np.array([[1, 0]], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = fluid.layers.data(name="d", shape=[K, 6], dtype="float32")
+        b = fluid.layers.data(name="b", shape=[-1, 4], dtype="float32", lod_level=1)
+        l = fluid.layers.data(name="l", shape=[-1], dtype="int64")
+        df = fluid.layers.data(name="df", shape=[-1], dtype="int64")
+        m, pc, tp, fp = fluid.layers.detection_map(
+            d, b, l, class_num=2, overlap_threshold=0.5,
+            gt_difficult=df, evaluate_difficult=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    mv, pcv, tpv, fpv = exe.run(
+        main,
+        feed={"d": det, "b": LoDArray(gtb, np.array([2], "int64")),
+              "l": gtl, "df": diff},
+        fetch_list=[m, pc, tp, fp])
+    # npos counts only the non-difficult gt
+    assert np.ravel(pcv)[1] == 1
+    # exactly one TP (det 1) and ZERO FPs: the neutral det 0 vanished
+    tp_scores = np.asarray(tpv)[1, :, 0]
+    fp_scores = np.asarray(fpv)[1, :, 0]
+    assert (tp_scores >= 0).sum() == 1
+    assert (fp_scores >= 0).sum() == 0
+    np.testing.assert_allclose(float(np.ravel(mv)[0]), 1.0, rtol=1e-5)

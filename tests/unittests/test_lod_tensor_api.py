@@ -106,3 +106,13 @@ def test_nested_set_lod_offsets_roundtrip():
     assert t.recursive_sequence_lengths() == [[2, 1], [2, 4, 1]]
     assert t.lod() == [[0, 2, 3], [0, 2, 6, 7]]
     assert t.has_valid_recursive_sequence_lengths()
+
+
+def test_create_lod_tensor_list_of_scalar_lists_is_one_level():
+    """Regression: [[1,2,3],[4,5]] is TWO 1-level sequences of scalars,
+    not a nested structure (the old behavior, which nested detection must
+    not break)."""
+    t = fluid.create_lod_tensor([[1, 2, 3], [4, 5]], None)
+    assert t.lod_level == 1
+    assert t.recursive_sequence_lengths() == [[3, 2]]
+    assert t.shape[0] == 2
