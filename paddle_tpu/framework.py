@@ -197,7 +197,7 @@ class Variable:
     __str__ = __repr__
 
     def to_dict(self):
-        return {
+        d = {
             "name": self.name,
             "shape": list(self.shape) if self.shape is not None else None,
             "dtype": self.dtype,
@@ -209,6 +209,13 @@ class Variable:
             "is_parameter": isinstance(self, Parameter),
             "trainable": getattr(self, "trainable", None),
         }
+        # tensor-array capacity changes compiled buffer sizes: it must ride
+        # serialization AND the fingerprint (the executor cache key), or two
+        # programs differing only in capacity share an executable
+        cap = getattr(self, "capacity", None)
+        if cap is not None:
+            d["capacity"] = int(cap)
+        return d
 
 
 class Parameter(Variable):
@@ -305,10 +312,12 @@ class Block:
 
     def create_parameter(self, **kwargs) -> Parameter:
         p = Parameter(self, **kwargs)
-        if p.name in self.vars:
-            raise ValueError("parameter %s already exists" % p.name)
-        # parameters always live in the root block
+        # parameters always live in the root block, so the duplicate check
+        # must look THERE — creating from inside a sub-block would
+        # otherwise silently replace a same-named root parameter
         root = self.program.block(0)
+        if p.name in self.vars or p.name in root.vars:
+            raise ValueError("parameter %s already exists" % p.name)
         p.block = root
         root.vars[p.name] = p
         self.program._bump()
@@ -498,8 +507,18 @@ class Program:
         kept.reverse()
         blk.ops = kept
         used = set()
+
+        def _collect(op):
+            used.update(op.all_input_names())
+            used.update(op.all_output_names())
+            # a While/StaticRNN body reads outer params its parent op never
+            # lists; dropping them from block 0 would strip the weights
+            if op.sub_block is not None:
+                for sop in op.sub_block.ops:
+                    _collect(sop)
+
         for op in kept:
-            used |= set(op.all_input_names()) | set(op.all_output_names())
+            _collect(op)
         used |= target_names
         blk.vars = OrderedDict((n, v) for n, v in blk.vars.items() if n in used)
         p._bump()
@@ -544,6 +563,8 @@ class Program:
                         v.trainable = trainable
                 else:
                     v = Variable(b, **{k: v2 for k, v2 in vd.items() if k in ("name", "shape", "dtype", "lod_level", "persistable", "stop_gradient", "is_data", "type")})
+                if vd.get("capacity") is not None:
+                    v.capacity = int(vd["capacity"])
                 b.vars[v.name] = v
             for od in bd["ops"]:
                 attrs = {}

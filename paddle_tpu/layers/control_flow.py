@@ -229,6 +229,17 @@ class While:
         while_block = main_program.current_block()
         parent_block = main_program.block(while_block.parent_idx)
 
+        # maxlen: raise the capacity of every tensor array written in the
+        # body (incl. nested conditionals) so long decodes don't silently
+        # clamp-overwrite the last slot
+        if self.maxlen:
+            for an in _array_write_targets(while_block):
+                blk = while_block if while_block.has_var_recursive(an) else parent_block
+                if blk.has_var_recursive(an):
+                    var = blk.var_recursive(an)
+                    var.capacity = max(int(getattr(var, "capacity", 0) or 0),
+                                       int(self.maxlen))
+
         # variables read from outer scope, and outer vars written inside
         inner_written = set()
         read = set()
@@ -257,6 +268,26 @@ class While:
         )
 
 
+def _array_write_targets(block):
+    """Tensor arrays written anywhere under ``block`` — including inside
+    nested conditional/while sub-blocks (a conditional array_write one
+    level down is still this loop's carried state)."""
+    out = []
+
+    def walk(blk):
+        for sop in blk.ops:
+            if sop.type == "write_to_array":
+                an = sop.outputs["Out"][0]
+                if an not in out:
+                    out.append(an)
+            sb = getattr(sop, "sub_block", None)
+            if sb is not None:
+                walk(sb)
+
+    walk(block)
+    return out
+
+
 @register("while")
 def _while_lower(ctx, op):
     """Lower a While op: carried env = condition + written outer vars +
@@ -271,13 +302,9 @@ def _while_lower(ctx, op):
     carried_names = list(op.outputs.get("Out", []))
     if cond_name not in carried_names:
         carried_names = [cond_name] + carried_names
-    # include array state (buffer + length) for arrays written in sub block
-    array_names = []
-    for sop in sub_block.ops:
-        if sop.type == "write_to_array":
-            an = sop.outputs["Out"][0]
-            if an not in array_names:
-                array_names.append(an)
+    # include array state (buffer + length) for arrays written anywhere in
+    # the body, nested conditionals included
+    array_names = _array_write_targets(sub_block)
 
     # initialize array buffers lazily: peek element shape by tracing one body
     # run is fragile; instead allocate on first write inside the body using
@@ -450,38 +477,40 @@ def _conditional_block_lower(ctx, op):
     conds = ctx.get_inputs(op, "Cond")
     pred = jnp.all(jnp.stack([c.reshape(-1).all() for c in conds]))
     out_names = list(op.outputs.get("Out", []))
+    # tensor arrays written in the branch live under @ARRAY/@ARRAYLEN, not
+    # the plain name — without carrying those keys a conditional
+    # array_write would be silently discarded
+    state_keys = list(out_names)
+    for an in _array_write_targets(sub_block):
+        for key in (an + "@ARRAY", an + "@ARRAYLEN"):
+            if key not in state_keys:
+                state_keys.append(key)
 
     def run_true(env_in):
         env2 = dict(env_in)
         c2 = ctx.child(env2)
         interpret_ops(c2, sub_block.ops)
-        return {n: env2[n] for n in out_names if n in env2}
+        return {n: env2[n] for n in state_keys if n in env2}
 
-    def run_false(env_in):
-        out = {}
-        for n in out_names:
-            if n in env_in:
-                out[n] = env_in[n]
-            else:
-                # var never assigned: zeros of the probe shape
-                out[n] = None
-        return out
-
-    # probe to learn shapes of outs not yet bound
+    # probe to learn shapes of state not yet bound
     probe = run_true(dict(ctx.env))
     fallback = {}
-    for n in out_names:
+    for n in state_keys:
         if ctx.has(n):
             fallback[n] = ctx.get(n)
         elif n in probe:
             fallback[n] = jnp.zeros_like(probe[n])
     env_now = {k: v for k, v in ctx.env.items()}
+    # branches must return identical pytrees: restrict to keys both have
+    keys = [n for n in state_keys if n in probe and n in fallback] or \
+           [n for n in state_keys if n in fallback]
 
     def t_branch(_):
-        return run_true(env_now)
+        out = run_true(env_now)
+        return {n: out.get(n, fallback[n]) for n in keys}
 
     def f_branch(_):
-        return {n: fallback[n] for n in fallback}
+        return {n: fallback[n] for n in keys}
 
     result = jax.lax.cond(pred, t_branch, f_branch, operand=None)
     for n, v in result.items():
@@ -630,6 +659,10 @@ class StaticRNN:
         self.status = StaticRNN.BEFORE_RNN_BLOCK
         self.seq_len = None
         self._mem_links = []
+        # name of an outer [batch, seq, ...] var whose @LENGTHS companion
+        # masks memory updates / outputs past each row's length
+        # (DynamicRNN sets this; plain StaticRNN leaves it unmasked)
+        self.mask_source = None
 
     class _Guard:
         def __init__(self, rnn):
@@ -746,6 +779,7 @@ class StaticRNN:
                 "mem_updates": [upd.name if upd is not None else "" for _, upd in self.memories.values()],
                 "step_outputs": [o.name for o in self.outputs],
                 "seq_len": self.seq_len,
+                "mask_input": self.mask_source or "",
             },
         )
 
@@ -772,7 +806,13 @@ def _static_rnn_lower(ctx, op):
     mem_updates = a["mem_updates"]
     step_out_names = a["step_outputs"]
 
-    def body(carry, xt):
+    # ragged masking (DynamicRNN): rows past their sequence length keep
+    # their memory frozen and emit zero outputs
+    mask_input = a.get("mask_input") or ""
+    lens = ctx.get_lengths(mask_input) if mask_input else None
+
+    def body(carry, step):
+        t, xt = step
         env2 = dict(ctx.env)
         for n, v in zip(mem_names, carry):
             env2[n] = v
@@ -784,12 +824,25 @@ def _static_rnn_lower(ctx, op):
             env2[u] if u else env2[n] for n, u in zip(mem_names, mem_updates)
         ]
         outs = [env2[n] for n in step_out_names]
+        if lens is not None:
+            alive = (t < jnp.asarray(lens).reshape(-1))  # [batch]
+
+            def mask_to(new, old):
+                m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+
+            new_carry = [mask_to(nv, ov) for nv, ov in zip(new_carry, carry)]
+            outs = [mask_to(o, jnp.zeros_like(o)) for o in outs]
         return tuple(new_carry), tuple(outs)
 
     xs_t = tuple(jnp.swapaxes(x, 0, 1) for x in xs)  # [seq, batch, ...]
-    _, outs = jax.lax.scan(body, tuple(inits), xs_t)
+    T = xs_t[0].shape[0] if xs_t else int(a.get("seq_len") or 0)
+    ts = jnp.arange(T, dtype=jnp.int32)
+    _, outs = jax.lax.scan(body, tuple(inits), (ts, xs_t))
     for name, o in zip(op.outputs["Outputs"], outs):
         ctx.set(name, jnp.swapaxes(o, 0, 1))  # back to [batch, seq, ...]
+        if lens is not None:
+            ctx.set_lengths(name, lens)
 
 
 class DynamicRNN:
@@ -801,12 +854,19 @@ class DynamicRNN:
         self._rnn = StaticRNN(name=name)
         self._lengths = None
         self._step_mask = None
+        self._first_ipt = None
 
     def block(self):
         return self._rnn.step()
 
     def step_input(self, x, lengths=None):
         ipt = self._rnn.step_input(x)
+        # x's @LENGTHS companion (or an explicit lengths var name) drives
+        # the per-row masking of memory updates and outputs
+        if self._rnn.mask_source is None:
+            self._rnn.mask_source = x.name
+        if self._first_ipt is None:
+            self._first_ipt = ipt
         return ipt
 
     def static_input(self, x):
@@ -816,8 +876,17 @@ class DynamicRNN:
         already readable inside the scan body — pass through)."""
         return x
 
-    def memory(self, init=None, shape=None, value=0.0, need_reorder=False, dtype="float32"):
-        return self._rnn.memory(init=init, shape=shape, init_value=value)
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32", batch_ref=None):
+        # shape-only memories size their batch from the first step_input
+        # (the reference sizes from the rank table; here the padded batch)
+        if init is None and batch_ref is None:
+            if self._first_ipt is None:
+                raise RuntimeError(
+                    "DynamicRNN.memory(shape=...) needs step_input() first")
+            batch_ref = self._first_ipt
+        return self._rnn.memory(init=init, shape=shape, init_value=value,
+                                batch_ref=batch_ref)
 
     def update_memory(self, ex_mem, new_mem):
         self._rnn.update_memory(ex_mem, new_mem)

@@ -49,9 +49,14 @@ class Optimizer:
         self._name = name
         self.regularization = regularization
         self._learning_rate = learning_rate
-        self._learning_rate_map = {}
+        # keyed by the Program OBJECT (weakly): id() is recycled by the GC,
+        # so an id-keyed map can hand program B the LR variable of a dead
+        # program A allocated at the same address
+        import weakref
+
+        self._learning_rate_map = weakref.WeakKeyDictionary()
         if isinstance(learning_rate, Variable):
-            self._learning_rate_map[id(default_main_program())] = learning_rate
+            self._learning_rate_map[default_main_program()] = learning_rate
         self._accumulators = defaultdict(dict)
         self.helper = None
         self._LARS_weight_decay = LARS_weight_decay
@@ -66,7 +71,7 @@ class Optimizer:
             raise ValueError("learning rate variable was created in another program")
         from .layers import tensor
 
-        self._learning_rate_map[id(program)] = tensor.create_global_var(
+        self._learning_rate_map[program] = tensor.create_global_var(
             name=unique_name.generate("learning_rate"),
             shape=[1],
             value=float(self._learning_rate),
@@ -76,7 +81,7 @@ class Optimizer:
 
     def _global_learning_rate(self, program=None):
         program = program or default_main_program()
-        return self._learning_rate_map.get(id(program))
+        return self._learning_rate_map.get(program)
 
     def _create_param_lr(self, param_and_grad):
         param = param_and_grad[0]
@@ -484,11 +489,11 @@ class ModelAverage(Optimizer):
         self._registered = False
         # reference semantics: constructing ModelAverage inside the program
         # context (after the real optimizer's minimize) registers the
-        # accumulator ops immediately
-        try:
+        # accumulator ops immediately.  Deferred explicitly when there is
+        # nothing to register yet — a bare except here would also mask real
+        # registration failures as "not registered"
+        if any(p.trainable for p in default_main_program().global_block().all_parameters()):
             self._register()
-        except Exception:
-            pass  # no trainable params yet; caller may _register() later
 
     def _register(self, program=None):
         program = program or default_main_program()

@@ -1,0 +1,156 @@
+"""Round-4 latent-bug regressions in control flow / framework core:
+conditional array writes, nested array detection, While(maxlen), masked
+DynamicRNN, tensor-array capacity serialization, prune keeping sub-block
+params."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+L = fluid.layers
+
+
+def test_conditional_block_array_write_is_applied():
+    """An array_write inside a ConditionalBlock must mutate the array when
+    the predicate is true (regression: @ARRAY state was dropped)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[2], dtype="float32")
+        flag = L.data(name="flag", shape=[1], dtype="bool")
+        arr = L.create_array("float32", capacity=4)
+        zero = L.zeros(shape=[1], dtype="int64")
+        cond = fluid.layers.ConditionalBlock([flag])
+        with cond.block():
+            L.array_write(x, zero, arr)
+        got = L.array_read(arr, zero)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    X = np.array([[3.0, 4.0]], "float32")
+    (true_out,) = exe.run(main, feed={"x": X, "flag": np.array([True])},
+                          fetch_list=[got])
+    np.testing.assert_allclose(np.ravel(true_out), [3.0, 4.0])
+    (false_out,) = exe.run(main, feed={"x": X, "flag": np.array([False])},
+                           fetch_list=[got])
+    np.testing.assert_allclose(np.ravel(false_out), [0.0, 0.0])  # untouched
+
+
+def test_while_with_nested_conditional_array_write():
+    """array_write nested inside a ConditionalBlock inside a While lowers
+    and accumulates (regression: KeyError 'read before written')."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[2], dtype="float32")
+        arr = L.create_array("float32", capacity=8)
+        i = L.zeros(shape=[1], dtype="int64")
+        limit = L.fill_constant(shape=[1], dtype="int64", value=3)
+        cond = L.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            is_even = L.equal(
+                L.elementwise_sub(
+                    x=i, y=L.scale(L.scale(i, scale=0.5), scale=2.0)),
+                L.zeros(shape=[1], dtype="int64"))
+            cb = fluid.layers.ConditionalBlock([is_even])
+            with cb.block():
+                L.array_write(x, i, arr)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=limit, cond=cond)
+        n = L.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (length,) = exe.run(main, feed={"x": np.ones((1, 2), "float32")},
+                        fetch_list=[n])
+    # writes at i=0 and i=2 (even): array length reaches 3 (max index 2 + 1)
+    assert int(np.ravel(length)[0]) == 3
+
+
+def test_while_maxlen_raises_array_capacity():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[2], dtype="float32")
+        arr = L.create_array("float32")  # default capacity
+        i = L.zeros(shape=[1], dtype="int64")
+        limit = L.fill_constant(shape=[1], dtype="int64", value=2)
+        cond = L.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond, maxlen=512)
+        with w.block():
+            L.array_write(x, i, arr)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=limit, cond=cond)
+    assert int(arr.capacity) == 512
+
+
+def test_dynamic_rnn_masks_short_sequences():
+    """Memory stops updating past each row's length (regression: pad steps
+    kept accumulating)."""
+    from paddle_tpu.lod import LoDArray
+
+    B, T, D = 2, 5, 1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[T, D], dtype="float32", lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            mem = drnn.memory(shape=[-1, D], value=0.0, batch_ref=xt)
+            acc = L.elementwise_add(x=mem, y=xt)
+            drnn.update_memory(mem, acc)
+            drnn.output(acc)
+        out = drnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    data = np.ones((B, T, D), "float32")
+    lens = np.array([2, 5], np.int32)
+    (o,) = exe.run(main, feed={"x": LoDArray(data, lens)}, fetch_list=[out])
+    o = np.asarray(o).reshape(B, T)
+    # row 0 (len 2): accumulates to 2 then freezes as ZERO outputs on pads
+    np.testing.assert_allclose(o[0], [1, 2, 0, 0, 0])
+    np.testing.assert_allclose(o[1], [1, 2, 3, 4, 5])
+
+
+def test_array_capacity_survives_serialization_and_keys_cache():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        arr = L.create_array("float32", capacity=64)
+    clone = fluid.Program.parse_from_string(main.to_string())
+    assert int(getattr(clone.global_block().var(arr.name), "capacity", 0)) == 64
+
+    # fingerprint must differ when only the capacity differs
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        L.create_array("float32", capacity=8)
+    main3, startup3 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main3, startup3):
+        L.create_array("float32", capacity=16)
+    assert main2.fingerprint() != main3.fingerprint()
+
+
+def test_prune_keeps_params_read_inside_static_rnn():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[4, 3], dtype="float32")
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[-1, 3], init_value=0.0, batch_ref=xt)
+            h = L.fc(input=xt, size=3, param_attr=fluid.ParamAttr(name="rnn_w"))
+            nxt = L.elementwise_add(x=mem, y=h)
+            rnn.update_memory(mem, nxt)
+            rnn.output(nxt)
+        out = rnn()
+    pruned = main.prune([out])
+    assert pruned.global_block().has_var("rnn_w")
+
+
+def test_block_create_parameter_duplicate_checks_root():
+    """Block.create_parameter from a sub-block must see root-block
+    duplicates (LayerHelper-level name sharing is separate and still
+    reuses by param_attr name)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        root = main.global_block()
+        root.create_parameter(name="w_dup", shape=[2, 2], dtype="float32")
+        sub = main.create_block()
+        with pytest.raises(ValueError, match="already exists"):
+            sub.create_parameter(name="w_dup", shape=[4, 4], dtype="float32")
+        main.rollback()
