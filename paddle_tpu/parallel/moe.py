@@ -23,7 +23,25 @@ import functools
 
 import numpy as np
 
-__all__ = ["switch_moe", "moe_expert_params"]
+__all__ = ["switch_moe", "moe_expert_params", "switch_moe_dense_reference"]
+
+
+def switch_moe_dense_reference(x, gate_w, expert_params, expert_fn):
+    """Per-token dense top-1 reference for ``switch_moe`` (no dispatch, no
+    capacity): every token runs its argmax expert, scaled by the gate prob.
+    Shared by the unit tests and the driver dryrun so the two equivalence
+    checks can't silently diverge from the engine's combine semantics."""
+    import jax
+    import jax.numpy as jnp
+
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(x) @ jnp.asarray(gate_w), axis=-1))
+    choice = probs.argmax(-1)
+    out = np.zeros_like(np.asarray(x))
+    for t in range(x.shape[0]):
+        e = int(choice[t])
+        p = jax.tree_util.tree_map(lambda a, _e=e: a[_e], expert_params)
+        out[t] = probs[t, e] * np.asarray(expert_fn(p, jnp.asarray(x[t:t + 1])))[0]
+    return out
 
 
 def moe_expert_params(per_expert):

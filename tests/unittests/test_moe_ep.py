@@ -6,7 +6,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from paddle_tpu.parallel.moe import moe_expert_params, switch_moe
+from paddle_tpu.parallel.moe import (
+    moe_expert_params,
+    switch_moe,
+    switch_moe_dense_reference,
+)
 
 
 def _expert_fn(params, tokens):
@@ -22,18 +26,8 @@ def _make(E=8, D=8, H=16, seed=0):
     return gate_w, per_expert, moe_expert_params(per_expert)
 
 
-def _dense_reference(x, gate_w, per_expert):
-    logits = x @ gate_w
-    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
-    expert = np.asarray(jnp.argmax(probs, axis=-1))
-    out = np.zeros_like(x)
-    for t in range(x.shape[0]):
-        e = int(expert[t])
-        h = np.asarray(_expert_fn(
-            {k: jnp.asarray(v) for k, v in per_expert[e].items()},
-            jnp.asarray(x[t: t + 1])))
-        out[t] = float(probs[t, e]) * h[0]
-    return out
+def _dense_reference(x, gate_w, stacked):
+    return switch_moe_dense_reference(x, gate_w, stacked, _expert_fn)
 
 
 def test_switch_moe_matches_dense():
@@ -46,7 +40,7 @@ def test_switch_moe_matches_dense():
     got = np.asarray(jax.jit(lambda x: switch_moe(
         x, jnp.asarray(gate_w), stacked, _expert_fn, mesh,
         capacity_factor=64.0))(x))  # capacity ample: no drops
-    want = _dense_reference(x, gate_w, per_expert)
+    want = _dense_reference(x, gate_w, stacked)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
@@ -60,7 +54,7 @@ def test_switch_moe_capacity_drops_tokens_softly():
     x = rng.randn(64, D).astype("float32")
     got = np.asarray(switch_moe(x, jnp.asarray(gate_w), stacked, _expert_fn,
                                 mesh, capacity_factor=1e-9))  # -> C = 1
-    want = _dense_reference(x, gate_w, per_expert)
+    want = _dense_reference(x, gate_w, stacked)
     nonzero = np.abs(got).sum(1) > 0
     # each of E source shards keeps at most 1 token per expert
     assert nonzero.sum() <= E * E

@@ -1,0 +1,9 @@
+#!/bin/bash
+# The required pytest entry point: mutually exclusive with TPU work via an
+# exclusive flock on /tmp/tpu_pytest.lock (shared with tools/tpu_watchdog.sh;
+# auto-released if either holder dies — no stale-flag hangs).  Blocks until
+# any in-flight TPU job finishes, then holds the lock for the whole suite.
+set -u
+cd /root/repo
+exec flock /tmp/tpu_pytest.lock \
+  env PALLAS_AXON_POOL_IPS= python -m pytest "${@:-tests/}" -q
