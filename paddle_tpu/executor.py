@@ -940,6 +940,24 @@ class Executor:
                     state_shardings = make_param_shardings(state, mesh, rules=rules)
                 else:
                     state_shardings = {n: repl for n in state}
+                # pipeline-stacked params (layers.Pipeline) shard their
+                # leading stage axis over 'pp' — each device holds ONE
+                # stage's slice; optimizer accumulators follow their param
+                # (name-prefixed, same leading dim)
+                pp_size = int(axis_sizes.get("pp", 1))
+                if pp_size > 1:
+                    stacked = {
+                        v.name for v in program.list_vars()
+                        if getattr(v, "pp_stacked", False)
+                    }
+                    if stacked:
+                        pp_shard = NamedSharding(mesh, P("pp"))
+                        for n, v in state.items():
+                            if np.ndim(v) < 1 or np.shape(v)[0] != pp_size:
+                                continue
+                            if n in stacked or any(
+                                    n.startswith(s + "_") for s in stacked):
+                                state_shardings[n] = pp_shard
                 jitted = jax.jit(
                     step,
                     in_shardings=(state_shardings, feed_shardings, repl),

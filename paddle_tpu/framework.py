@@ -215,6 +215,10 @@ class Variable:
         cap = getattr(self, "capacity", None)
         if cap is not None:
             d["capacity"] = int(cap)
+        # pipeline-stacked parameters carry their leading stage axis through
+        # serialization (the executor's pp sharding keys off this flag)
+        if getattr(self, "pp_stacked", False):
+            d["pp_stacked"] = True
         return d
 
 
@@ -565,6 +569,8 @@ class Program:
                     v = Variable(b, **{k: v2 for k, v2 in vd.items() if k in ("name", "shape", "dtype", "lod_level", "persistable", "stop_gradient", "is_data", "type")})
                 if vd.get("capacity") is not None:
                     v.capacity = int(vd["capacity"])
+                if vd.get("pp_stacked"):
+                    v.pp_stacked = True
                 b.vars[v.name] = v
             for od in bd["ops"]:
                 attrs = {}

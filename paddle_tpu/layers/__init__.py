@@ -10,6 +10,7 @@ from . import metric_op
 from . import learning_rate_scheduler
 from . import sequence as sequence_mod
 from . import detection
+from . import pipeline as pipeline_mod
 
 from .nn import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
@@ -20,6 +21,7 @@ from .metric_op import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .pipeline import Pipeline  # noqa: F401
 
 __all__ = (
     nn.__all__
@@ -31,4 +33,5 @@ __all__ = (
     + learning_rate_scheduler.__all__
     + sequence_mod.__all__
     + detection.__all__
+    + pipeline_mod.__all__
 )

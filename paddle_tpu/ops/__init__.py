@@ -13,3 +13,4 @@ from . import struct_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
+from . import pipeline_ops  # noqa: F401

@@ -1,0 +1,77 @@
+"""``pipeline`` op lowering (first-class pp through the Program API).
+
+No reference analog (Fluid v0.15 is dp-only).  ``layers.Pipeline``
+appends one op holding the stacked per-stage parameters and a sub-block
+with the stage body; this lowering traces the body once as
+``stage_fn(param_slices, activation)`` and runs it
+
+* under the GPipe fill-drain engine (parallel/pipeline.py) when the
+  executor mesh carries a ``pp`` axis whose size matches ``num_stages``
+  — the mesh IS the opt-in, mirroring switch_moe's ep rule; or
+* as a sequential microbatch loop on one device otherwise.
+
+Both paths process each of the M microbatches independently, so their
+numerics agree for per-sample stage bodies (see layers/pipeline.py).
+The backward meta-op differentiates straight through either path: the
+GPipe schedule is built from ``ppermute``/``scan``/``psum``, all of
+which have transpose rules, so ``jax.value_and_grad`` of a pipelined
+loss IS pipeline-parallel backward.
+"""
+from __future__ import annotations
+
+from ..registry import register
+
+
+@register("pipeline")
+def _pipeline(ctx, op):
+    import jax
+
+    from ..executor import interpret_ops
+
+    x = ctx.get_input(op, "X")
+    params = ctx.get_inputs(op, "Params")   # each stacked [S, ...]
+    sub = op.sub_block
+    a = op.attrs
+    S = int(a["num_stages"])
+    M = int(a["num_microbatches"])
+    locals_ = list(a["param_locals"])
+    in_local, out_local = a["input_local"], a["output_local"]
+
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(
+            "pipeline batch %d is not divisible by num_microbatches %d"
+            % (B, M))
+    stacked = dict(zip(locals_, params))
+
+    def stage_fn(pdict, h):
+        env2 = dict(ctx.env)
+        env2.update(pdict)
+        env2[in_local] = h
+        c2 = ctx.child(env2)
+        interpret_ops(c2, sub.ops)
+        return env2[out_local]
+
+    mesh = ctx.mesh
+    pp = 0
+    if mesh is not None:
+        pp = int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 0))
+
+    if pp > 1 and pp == S:
+        from ..parallel.pipeline import pipeline_apply
+
+        out = pipeline_apply(
+            lambda p, h: stage_fn(p, h), stacked, x, mesh, M, axis_name="pp")
+    else:
+        # single-device reference: same microbatch split, stages in sequence
+        mb = B // M
+        xs = x.reshape((M, mb) + tuple(x.shape[1:]))
+
+        def run_chain(xm):
+            h = xm
+            for s in range(S):
+                h = stage_fn({n: p[s] for n, p in stacked.items()}, h)
+            return h
+
+        out = jax.lax.map(run_chain, xs).reshape((B,) + tuple(x.shape[1:]))
+    ctx.set_output(op, "Out", out)

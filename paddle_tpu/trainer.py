@@ -120,7 +120,9 @@ class Trainer:
         every device (the reference's ParallelExecutor-under-Trainer mode);
         a ``(dp, tp[, sp])`` tuple or ``{axis: size}`` dict = multi-axis
         mesh with Megatron tp shardings (parallel_executor.build_mesh),
-        refined by ``sharding_rules``."""
+        refined by ``sharding_rules``.  A ``pp`` axis runs layers.Pipeline
+        stages GPipe-style (one stage per device); an ``ep`` axis runs
+        layers.switch_moe experts with all-to-all dispatch."""
         from .core import TPUPlace
 
         self.place = place if place is not None else TPUPlace()
