@@ -25,6 +25,7 @@ __all__ = [
     "load_persistables",
     "save_inference_model",
     "load_inference_model",
+    "load_aot_inference_model",
     "get_inference_program",
     "is_parameter",
     "is_persistable",
@@ -120,7 +121,19 @@ def save_inference_model(
     model_filename=None,
     params_filename=None,
     export_for_deployment=True,
+    aot=False,
+    aot_feed_shapes=None,
+    aot_platforms=None,
 ):
+    """``aot=True`` additionally serializes a compiled executable
+    (``__aot__`` StableHLO artifact via jax.export) with the weights baked
+    in: a fresh process loads and predicts with NO Program rebuild and no
+    re-trace — the deployment story the reference covers with its C++
+    predictor (paddle/fluid/inference/api/paddle_inference_api.h,
+    api_impl.cc).  The batch dim exports symbolically, so one artifact
+    serves any batch size; other dims must be static (override with
+    ``aot_feed_shapes={name: shape}``).  ``aot_platforms`` defaults to
+    ("cpu", "tpu") — one artifact runs on either."""
     main_program = main_program or default_main_program()
     if isinstance(feeded_var_names, str):
         feeded_var_names = [feeded_var_names]
@@ -137,7 +150,85 @@ def save_inference_model(
         json.dump(model, f)
     params = [v for v in inference_program.list_vars() if is_persistable(v)]
     save_vars(executor, dirname, vars=params, filename=params_filename)
+    if aot:
+        _export_aot(
+            dirname, inference_program, model["feed_names"],
+            model["fetch_names"], aot_feed_shapes, aot_platforms)
     return model["fetch_names"]
+
+
+def _export_aot(dirname, inference_program, feed_names, fetch_names,
+                feed_shapes=None, platforms=None):
+    import jax
+    from jax import export as jax_export
+
+    from .jax_bridge import program_to_fn
+    from .ops.common import to_jdtype
+
+    scope = global_scope()
+    state = {
+        v.name: np.asarray(scope.vars[v.name])
+        for v in inference_program.list_vars()
+        if is_persistable(v) and scope.vars.get(v.name) is not None
+    }
+    fn = program_to_fn(inference_program, fetch_names, is_test=True)
+
+    def predict(*feed_arrays):
+        return tuple(fn(state, dict(zip(feed_names, feed_arrays))))
+
+    (b,) = jax_export.symbolic_shape("b")
+    specs, dtypes = [], []
+    for name in feed_names:
+        var = inference_program.global_block().var(name)
+        shape = list((feed_shapes or {}).get(name) or var.shape)
+        if shape and int(shape[0]) in (-1, 0):
+            shape[0] = b
+        if any(isinstance(s, int) and s <= 0 for s in shape):
+            raise ValueError(
+                "AOT export needs static non-batch dims for feed %r, got %s "
+                "(pass aot_feed_shapes={%r: full_shape})" % (name, shape, name))
+        dt = to_jdtype(var.dtype)
+        specs.append(jax.ShapeDtypeStruct(tuple(shape), dt))
+        dtypes.append(np.dtype(dt).name)
+    platforms = tuple(platforms or ("cpu", "tpu"))
+    exported = jax_export.export(jax.jit(predict), platforms=platforms)(*specs)
+    with open(os.path.join(dirname, "__aot__"), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, "__aot_meta__"), "w") as f:
+        json.dump({
+            "feed_names": list(feed_names),
+            "feed_dtypes": dtypes,
+            "feed_shapes": [
+                [str(d) for d in s.shape] for s in specs],
+            "fetch_names": list(fetch_names),
+            "platforms": list(platforms),
+            "jax_version": jax.__version__,
+        }, f)
+
+
+def load_aot_inference_model(dirname):
+    """Load an ``aot=True`` artifact WITHOUT rebuilding the Program or
+    re-tracing: returns ``(predict, feed_names, fetch_names)`` where
+    ``predict(feed_dict) -> [fetch arrays]`` runs the deserialized
+    compiled executable (weights baked in; batch size free).  The
+    standalone CLI ``tools/predict.py`` does the same with only
+    jax + numpy on the path."""
+    import jax
+    from jax import export as jax_export
+
+    with open(os.path.join(dirname, "__aot_meta__")) as f:
+        meta = json.load(f)
+    with open(os.path.join(dirname, "__aot__"), "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+    call = jax.jit(exported.call)
+    feed_names = meta["feed_names"]
+    dtypes = [np.dtype(d) for d in meta["feed_dtypes"]]
+
+    def predict(feed):
+        args = [np.asarray(feed[n], dt) for n, dt in zip(feed_names, dtypes)]
+        return [np.asarray(o) for o in call(*args)]
+
+    return predict, feed_names, meta["fetch_names"]
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None):

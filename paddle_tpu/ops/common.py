@@ -16,6 +16,17 @@ def to_jdtype(dtype):
     return np_dtype(dtype)
 
 
+def dim_prod(dims):
+    """Product of shape dims via reduce-mul, NOT int(np.prod(...)): under
+    jax.export shape polymorphism (io._export_aot) a dim may be symbolic,
+    and forcing it to int raises InconclusiveDimensionOperation.  Every
+    shape-product in a lowering rule must use this."""
+    import functools
+    import operator
+
+    return functools.reduce(operator.mul, dims, 1)
+
+
 def bcast_y(x, y, axis: int):
     """Reference elementwise broadcast semantics
     (paddle/fluid/operators/elementwise_op_function.h): ``y``'s shape is

@@ -75,8 +75,10 @@ def _mul(ctx, op):
     xn = op.attrs.get("x_num_col_dims", 1)
     yn = op.attrs.get("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
-    x2 = x.reshape((int(np.prod(xs[:xn])), -1))
-    y2 = y.reshape((int(np.prod(ys[:yn])), -1))
+    from .common import dim_prod
+
+    x2 = x.reshape((dim_prod(xs[:xn]), -1))
+    y2 = y.reshape((dim_prod(ys[:yn]), -1))
     out = jnp.matmul(x2, y2)
     ctx.set_output(op, "Out", out.reshape(tuple(xs[:xn]) + tuple(ys[yn:])))
 

@@ -283,7 +283,8 @@ def _sequence_reshape(ctx, op):
     lens = _lengths_for(ctx, op)
     new_dim = int(op.attrs["new_dim"])
     B, T = x.shape[0], x.shape[1]
-    D = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
+    from .common import dim_prod
+    D = dim_prod(x.shape[2:]) if x.ndim > 2 else 1
     if (T * D) % new_dim:
         raise ValueError("sequence_reshape: T*D=%d not divisible by new_dim=%d" % (T * D, new_dim))
     out = x.reshape(B, (T * D) // new_dim, new_dim)

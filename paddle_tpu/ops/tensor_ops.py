@@ -127,7 +127,9 @@ def _transpose(ctx, op):
 def _flatten(ctx, op):
     x = ctx.get_input(op, "X")
     ax = op.attrs.get("axis", 1)
-    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    from .common import dim_prod
+
+    lead = dim_prod(x.shape[:ax]) if ax > 0 else 1
     ctx.set_output(op, "Out", x.reshape((lead, -1)))
 
 

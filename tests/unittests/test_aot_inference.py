@@ -1,0 +1,91 @@
+"""AOT inference artifact (io.save_inference_model(aot=True)): a compiled
+executable serialized via jax.export, loadable in a FRESH process with no
+Program rebuild and no re-trace, matching in-process outputs exactly.
+Reference analog: the C++ predictor deployment path
+(paddle/fluid/inference/api/paddle_inference_api.h)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_and_save(dirname):
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.io.save_inference_model(dirname, ["x"], [out], exe,
+                                  main_program=main, aot=True)
+    X = np.random.RandomState(0).randn(6, 8).astype("float32")
+    want = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
+    return X, np.asarray(want)
+
+
+def test_aot_roundtrip_in_process(tmp_path):
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        X, want = _build_and_save(d)
+    assert os.path.exists(os.path.join(d, "__aot__"))
+    predict, feed_names, fetch_names = fluid.io.load_aot_inference_model(d)
+    assert feed_names == ["x"]
+    got = predict({"x": X})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # the batch dim exported symbolically: other batch sizes, same artifact
+    X2 = np.random.RandomState(1).randn(3, 8).astype("float32")
+    assert predict({"x": X2})[0].shape == (3, 4)
+
+
+def test_aot_fresh_process_standalone_predictor(tmp_path):
+    """save in THIS process; predict via tools/predict.py in a fresh
+    interpreter that never imports paddle_tpu — identical outputs."""
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        X, want = _build_and_save(d)
+    xfile = str(tmp_path / "x.npy")
+    ofile = str(tmp_path / "out.npz")
+    np.save(xfile, X)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""  # prove: no paddle_tpu on the path
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "predict.py"),
+         d, xfile, "--out", ofile],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    got = np.load(ofile)
+    (fetch_name,) = list(got.keys())
+    np.testing.assert_allclose(got[fetch_name], want, rtol=1e-6, atol=1e-7)
+
+
+def test_aot_requires_static_nonbatch_dims(tmp_path):
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        # ragged time dim: shape (-1, -1, 8) has a dynamic NON-batch dim
+        x = fluid.layers.data(name="x", shape=[-1, -1, 8], dtype="float32",
+                              append_batch_size=False)
+        out = fluid.layers.relu(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        try:
+            fluid.io.save_inference_model(
+                str(tmp_path / "m"), ["x"], [out], exe, main_program=main,
+                aot=True)
+            raised = False
+        except ValueError as e:
+            raised = "static non-batch dims" in str(e)
+    assert raised

@@ -1,0 +1,69 @@
+"""Standalone AOT predictor: run a ``save_inference_model(..., aot=True)``
+artifact with ONLY jax + numpy on the path — no paddle_tpu import, no
+Program rebuild, no re-trace.  The deployment-side analog of the
+reference's C++ predictor binary
+(paddle/fluid/inference/api/paddle_inference_api.h, api_impl.cc, and the
+train/demo standalone programs).
+
+Usage:
+    python tools/predict.py MODEL_DIR --feed name=file.npy [...] \
+        [--out results.npz] [--print]
+
+Feeds default to positional: bare ``file.npy`` arguments bind to the
+exported feed names in order.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model_dir")
+    ap.add_argument("inputs", nargs="*", help="positional feed .npy files")
+    ap.add_argument("--feed", action="append", default=[],
+                    metavar="NAME=FILE.npy", help="named feed")
+    ap.add_argument("--out", default=None, help="write fetches to this .npz")
+    ap.add_argument("--print", dest="do_print", action="store_true",
+                    help="print fetch summaries to stdout")
+    args = ap.parse_args(argv)
+
+    with open(os.path.join(args.model_dir, "__aot_meta__")) as f:
+        meta = json.load(f)
+    feed_names = meta["feed_names"]
+
+    feeds = {}
+    for spec in args.feed:
+        name, _, path = spec.partition("=")
+        feeds[name] = np.load(path)
+    for name, path in zip([n for n in feed_names if n not in feeds], args.inputs):
+        feeds[name] = np.load(path)
+    missing = [n for n in feed_names if n not in feeds]
+    if missing:
+        ap.error("missing feeds: %s" % missing)
+
+    import jax
+    from jax import export as jax_export
+
+    with open(os.path.join(args.model_dir, "__aot__"), "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+    arrs = [np.asarray(feeds[n], np.dtype(dt))
+            for n, dt in zip(feed_names, meta["feed_dtypes"])]
+    outs = [np.asarray(o) for o in jax.jit(exported.call)(*arrs)]
+
+    if args.out:
+        np.savez(args.out, **dict(zip(meta["fetch_names"], outs)))
+    if args.do_print or not args.out:
+        for n, o in zip(meta["fetch_names"], outs):
+            print("%s: shape=%s dtype=%s mean=%.6f"
+                  % (n, tuple(o.shape), o.dtype, float(np.mean(o))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
