@@ -11,10 +11,17 @@ times is dropped with a warning (reference: MaxChunksFailure).
 Transport is the same length-prefixed pickle as the dense pserver
 (transpiler/pserver_runtime.py); the master is host-side control plane,
 never on the TPU path.
+
+``snapshot_path`` persists the task state (todo/pending/failures) across
+master restarts — the analog of the reference's master state in etcd
+(go/master/etcd_client.go): a restarted master resumes the epoch with no
+chunk lost; chunks that were leased at crash time are redispatched
+(at-least-once, same as a lease expiry).
 """
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import socket
 import struct
@@ -51,7 +58,8 @@ def _recv_msg(sock):
 class Master:
     """Chunk-queue server for one pass over the data."""
 
-    def __init__(self, chunks, lease_seconds=10.0, max_failures=3):
+    def __init__(self, chunks, lease_seconds=10.0, max_failures=3,
+                 snapshot_path=None):
         self._todo = [(i, c) for i, c in enumerate(chunks)]
         self._pending = {}  # task_id -> (chunk, deadline)
         self._failures = {}  # task_id -> count
@@ -59,10 +67,107 @@ class Master:
         self._lock = threading.Lock()
         self._lease = float(lease_seconds)
         self._max_failures = int(max_failures)
+        self._snapshot_path = snapshot_path
+        self._persist_lock = threading.Lock()
+        self._log_f = None
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._restore(snapshot_path)
+        elif snapshot_path:
+            self._write_base()
         self._sock = None
         self._thread = None
         self._stop = threading.Event()
         self.port = None
+
+    # -- persistence: base file + append-only event log ---------------------
+    # The base file holds the epoch's full chunk list, written ONCE; each
+    # ack/failure appends one tiny pickle record to ``<path>.log`` (O(1) per
+    # event — a full-state rewrite per ack would be O(N) disk traffic per
+    # event).  Leases are deliberately NOT persisted: a restart voids them
+    # and redispatches every un-acked chunk, which is exactly the lease-
+    # expiry semantics.  A completed pass unlinks both files so the next
+    # epoch's Master starts from its chunks argument.
+
+    def _write_base(self):
+        with self._persist_lock:
+            # truncate any stale log BEFORE the base lands: task ids are
+            # dense indices, so a crash that paired a fresh base with a
+            # previous epoch's log would replay colliding 'done' events and
+            # silently drop never-served chunks
+            open(self._snapshot_path + ".log", "wb").close()
+            tmp = self._snapshot_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({"todo": list(self._todo)}, f, protocol=4)
+            os.replace(tmp, self._snapshot_path)
+
+    def _restore(self, path):
+        with open(path, "rb") as f:
+            base = pickle.load(f)
+        todo = dict(base["todo"])
+        failures, dropped = {}, 0
+        try:
+            with open(path + ".log", "rb+") as f:
+                good = 0
+                while True:
+                    try:
+                        kind, tid = pickle.load(f)
+                    except EOFError:
+                        break
+                    except Exception:
+                        # torn final record (crash mid-append): drop it —
+                        # and TRUNCATE, or post-recovery appends would land
+                        # after the unreadable bytes and be lost to every
+                        # later replay (re-running already-acked chunks)
+                        f.truncate(good)
+                        break
+                    good = f.tell()
+                    if kind == "done":
+                        todo.pop(tid, None)
+                    elif kind == "fail":
+                        n = failures.get(tid, 0) + 1
+                        failures[tid] = n
+                        if n >= self._max_failures and tid in todo:
+                            del todo[tid]
+                            dropped += 1
+        except FileNotFoundError:
+            pass
+        if not todo:
+            # completed-pass leftover (crash between the last ack and the
+            # unlink): a fresh epoch must NOT inherit an empty queue and
+            # silently serve zero chunks
+            log.warning("master: ignoring completed-pass snapshot %r", path)
+            self._clear_snapshot()
+            if self._todo:
+                self._write_base()
+            return
+        self._todo = list(todo.items())
+        self._failures = failures
+        self._dropped = dropped
+
+    def _log_event(self, kind, tid):
+        if not self._snapshot_path:
+            return
+        with self._persist_lock:
+            if self._log_f is None:
+                self._log_f = open(self._snapshot_path + ".log", "ab")
+            pickle.dump((kind, tid), self._log_f, protocol=4)
+            self._log_f.flush()
+
+    def _clear_snapshot(self):
+        if not self._snapshot_path:
+            return
+        with self._persist_lock:
+            if self._log_f is not None:
+                self._log_f.close()
+                self._log_f = None
+            # log first, base second: a crash in between leaves a base with
+            # no log (harmless full redispatch), never an orphan log that a
+            # future epoch's base could be paired with
+            for p in (self._snapshot_path + ".log", self._snapshot_path):
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
 
     # -- queue core (usable in-process without the TCP layer) ---------------
 
@@ -71,6 +176,7 @@ class Master:
         for tid in expired:
             chunk, _ = self._pending.pop(tid)
             self._fail_locked(tid, chunk, "lease expired")
+        return expired
 
     def _fail_locked(self, tid, chunk, why):
         n = self._failures.get(tid, 0) + 1
@@ -86,24 +192,42 @@ class Master:
         ("done",) when the pass is complete."""
         with self._lock:
             now = time.monotonic()
-            self._requeue_expired(now)
+            expired = self._requeue_expired(now)
             if self._todo:
                 tid, chunk = self._todo.pop(0)
                 self._pending[tid] = (chunk, now + self._lease)
-                return ("task", tid, chunk)
-            if self._pending:
-                return ("wait",)
-            return ("done",)
+                out = ("task", tid, chunk)
+            elif self._pending:
+                out = ("wait",)
+            else:
+                out = ("done",)
+        # expiries count as failures in the recovery log too (they feed the
+        # max_failures drop rule); plain leases are not persisted — a
+        # restart voids them by redispatching every un-acked chunk
+        for tid_ in expired:
+            self._log_event("fail", tid_)
+        return out
 
     def task_finished(self, tid):
         with self._lock:
-            self._pending.pop(tid, None)
+            changed = self._pending.pop(tid, None) is not None
+            done = not self._todo and not self._pending
+        if changed:
+            self._log_event("done", tid)
+        if done:
+            self._clear_snapshot()
 
     def task_failed(self, tid):
         with self._lock:
-            if tid in self._pending:
+            changed = tid in self._pending
+            if changed:
                 chunk, _ = self._pending.pop(tid)
                 self._fail_locked(tid, chunk, "reported failed")
+            done = not self._todo and not self._pending
+        if changed:
+            self._log_event("fail", tid)
+        if done:
+            self._clear_snapshot()
 
     def done(self):
         with self._lock:

@@ -51,6 +51,112 @@ def test_failed_task_redispatched_then_dropped():
     m.stop()
 
 
+def test_master_restart_mid_epoch_loses_no_chunks(tmp_path):
+    """Kill-and-resume (reference: master state in etcd,
+    go/master/etcd_client.go): a master restarted mid-epoch from its
+    snapshot redispatches every unfinished chunk — including the one that
+    was leased at crash time — and no chunk is lost or re-run after ack."""
+    snap = str(tmp_path / "master.snap")
+    chunks = ["c%d" % i for i in range(6)]
+
+    m1 = Master(chunks, lease_seconds=30, snapshot_path=snap)
+    port = m1.start()
+    c = MasterClient("127.0.0.1:%d" % port)
+    # finish two chunks, leave a third LEASED at crash time
+    for _ in range(2):
+        tid, _chunk = c.get_task()
+        c.task_finished(tid)
+    leased_tid, leased_chunk = c.get_task()
+    c.close()
+    m1.stop()  # crash: the lease is still outstanding
+
+    # restart purely from the snapshot (chunks arg deliberately empty:
+    # state must come from disk)
+    m2 = Master([], lease_seconds=30, snapshot_path=snap)
+    port2 = m2.start()
+    c2 = MasterClient("127.0.0.1:%d" % port2)
+    seen = []
+    while True:
+        task = c2.get_task(poll_interval=0.05)
+        if task is None:
+            break
+        tid, chunk = task
+        seen.append(chunk)
+        c2.task_finished(tid)
+    c2.close()
+    m2.stop()
+
+    # the crashed lease's chunk comes back FIRST (expired-lease semantics)
+    assert seen[0] == leased_chunk
+    # exactly the four unfinished chunks, each once
+    assert sorted(seen) == sorted(set(chunks) - set(chunks[:2]))
+
+
+def test_master_torn_log_record_truncated_on_recovery(tmp_path):
+    """A crash mid-append tears the log's final record; recovery must
+    truncate it so post-recovery acks survive the NEXT restart too."""
+    snap = str(tmp_path / "m.snap")
+    m1 = Master(["a", "b", "c", "d"], lease_seconds=30, snapshot_path=snap)
+    port = m1.start()
+    c = MasterClient("127.0.0.1:%d" % port)
+    tid, _ = c.get_task()
+    c.task_finished(tid)  # 'a' acked
+    c.close()
+    m1.stop()
+    with open(snap + ".log", "ab") as f:
+        f.write(b"\x80\x04torn")  # crash mid-append
+
+    m2 = Master([], lease_seconds=30, snapshot_path=snap)
+    port = m2.start()
+    c = MasterClient("127.0.0.1:%d" % port)
+    tid, chunk = c.get_task()
+    assert chunk == "b"
+    c.task_finished(tid)  # ack AFTER recovery: must persist durably
+    c.close()
+    m2.stop()
+
+    m3 = Master([], lease_seconds=30, snapshot_path=snap)
+    port = m3.start()
+    c = MasterClient("127.0.0.1:%d" % port)
+    seen = []
+    while True:
+        t = c.get_task(poll_interval=0.05)
+        if t is None:
+            break
+        seen.append(t[1])
+        c.task_finished(t[0])
+    c.close()
+    m3.stop()
+    assert sorted(seen) == ["c", "d"]  # neither 'a' nor 'b' re-dispatched
+
+
+def test_master_snapshot_cleared_after_pass_completes(tmp_path):
+    """A completed pass unlinks its snapshot, so the next epoch's Master
+    (same snapshot_path) serves its own chunk list — not a stale empty
+    queue."""
+    import os
+    snap = str(tmp_path / "m.snap")
+
+    def run_epoch(chunks):
+        m = Master(chunks, lease_seconds=30, snapshot_path=snap)
+        port = m.start()
+        c = MasterClient("127.0.0.1:%d" % port)
+        seen = []
+        while True:
+            t = c.get_task(poll_interval=0.05)
+            if t is None:
+                break
+            seen.append(t[1])
+            c.task_finished(t[0])
+        c.close()
+        m.stop()
+        return seen
+
+    assert sorted(run_epoch(["a", "b"])) == ["a", "b"]
+    assert not os.path.exists(snap)  # completed pass cleaned up
+    assert sorted(run_epoch(["c", "d", "e"])) == ["c", "d", "e"]
+
+
 def test_master_task_reader_end_to_end(tmp_path):
     # three pickled sample files; two concurrent reader-trainers; one dies
     # mid-stream. Every sample is still consumed by the survivor.
