@@ -37,6 +37,33 @@ def _data(batch=32, seed=0):
             rng.randn(batch, D).astype("float32"))
 
 
+def _run_losses(build_fn, mesh, X, Y, steps, collect_params=False):
+    """Shared seq-vs-ParallelExecutor harness: train ``steps`` on a fresh
+    program/scope; mesh=None runs the plain Executor (sequential path)."""
+    main, startup, loss = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        runner = (fluid.ParallelExecutor(loss_name=loss.name,
+                                         main_program=main, mesh_shape=mesh)
+                  if mesh else exe)
+        losses = []
+        for _ in range(steps):
+            if mesh:
+                vals = runner.run(fetch_list=[loss], feed={"x": X, "y": Y})
+            else:
+                vals = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            losses.append(float(np.ravel(vals[0]).mean()))
+        params = None
+        if collect_params:
+            params = {
+                p.name: np.asarray(
+                    fluid.global_scope().find_var(p.name).get_tensor())
+                for p in main.global_block().all_parameters()
+            }
+    return (losses, params) if collect_params else losses
+
+
 def test_pipeline_param_is_stacked():
     main, startup, _ = _build()
     params = main.global_block().all_parameters()
@@ -64,38 +91,10 @@ def test_pipeline_pp_matches_sequential():
     """The GPipe schedule over an 8-device mesh's pp axis produces the same
     losses AND post-training parameters as the sequential microbatch loop."""
     X, Y = _data(seed=1)
-
-    main, startup, loss = _build()
-    exe = fluid.Executor(fluid.CPUPlace())
-    with fluid.scope_guard(fluid.Scope()):
-        exe.run(startup)
-        seq_losses = [
-            float(np.ravel(exe.run(main, feed={"x": X, "y": Y},
-                                   fetch_list=[loss])[0])[0])
-            for _ in range(4)
-        ]
-        seq_params = {
-            p.name: np.asarray(fluid.global_scope().find_var(p.name).get_tensor())
-            for p in main.global_block().all_parameters()
-        }
-
-    main2, startup2, loss2 = _build()
-    exe2 = fluid.Executor(fluid.CPUPlace())
-    with fluid.scope_guard(fluid.Scope()):
-        exe2.run(startup2)
-        pexe = fluid.ParallelExecutor(
-            loss_name=loss2.name, main_program=main2,
-            mesh_shape={"dp": 1, "pp": S})
-        pp_losses = [
-            float(np.ravel(pexe.run(fetch_list=[loss2],
-                                    feed={"x": X, "y": Y})[0]).mean())
-            for _ in range(4)
-        ]
-        pp_params = {
-            p.name: np.asarray(fluid.global_scope().find_var(p.name).get_tensor())
-            for p in main2.global_block().all_parameters()
-        }
-
+    seq_losses, seq_params = _run_losses(_build, None, X, Y, 4,
+                                         collect_params=True)
+    pp_losses, pp_params = _run_losses(_build, {"dp": 1, "pp": S}, X, Y, 4,
+                                       collect_params=True)
     np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-4, atol=1e-6)
     for n, want in seq_params.items():
         np.testing.assert_allclose(
@@ -135,6 +134,48 @@ def test_pipeline_program_roundtrip_keeps_stacked_flag():
     assert all(
         getattr(test_clone.global_block().vars[p.name], "pp_stacked", False)
         for p in params)
+
+
+def test_pipeline_composes_with_dp_axis():
+    """dp2 x pp4 mesh: batch data-parallel outside the pipeline, stages
+    sharded inside it — same numerics as single-device sequential."""
+    X, Y = _data(batch=32, seed=4)
+    seq = _run_losses(_build, None, X, Y, 3)
+    got = _run_losses(_build, {"dp": 2, "pp": S}, X, Y, 3)
+    np.testing.assert_allclose(got, seq, rtol=2e-4, atol=1e-6)
+
+
+def test_pipeline_transformer_block_stage():
+    """Realistic stage body (the flagship pp use case: stacked transformer
+    blocks) — fc -> layer_norm -> residual per stage, trained pp vs
+    sequential."""
+    fluid.unique_name.switch()
+
+    def build():
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[D], dtype="float32")
+            pipe = fluid.layers.Pipeline(num_stages=2, num_microbatches=4)
+            with pipe.stage():
+                h = pipe.stage_input(x)
+                ff = fluid.layers.fc(h, size=D * 2, act="relu")
+                ff = fluid.layers.fc(ff, size=D)
+                res = fluid.layers.elementwise_add(h, ff)
+                out = fluid.layers.layer_norm(res)
+                pipe.stage_output(out)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pipe(), label=y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    X, Y = _data(batch=16, seed=5)
+    seq = _run_losses(build, None, X, Y, 3)
+    pp = _run_losses(build, {"dp": 1, "pp": 2}, X, Y, 3)
+    np.testing.assert_allclose(pp, seq, rtol=2e-4, atol=1e-6)
+    assert seq[-1] < seq[0]
 
 
 def test_pipeline_under_trainer():
