@@ -50,7 +50,9 @@ def _quantized_mul(ctx, op):
     ws = ctx.get_input(op, "WScale")    # f32 [N] per output channel
     xn = op.attrs.get("x_num_col_dims", 1)
     xs = x.shape
-    x2 = x.reshape((int(np.prod(xs[:xn])), -1))
+    from ...ops.common import dim_prod
+
+    x2 = x.reshape((dim_prod(xs[:xn]), -1))
     xq, sx = _quantize_activation(x2)
     acc = lax.dot_general(
         xq, wq.astype(jnp.int8),

@@ -69,6 +69,66 @@ def test_aot_fresh_process_standalone_predictor(tmp_path):
     np.testing.assert_allclose(got[fetch_name], want, rtol=1e-6, atol=1e-7)
 
 
+def test_aot_conv_model_roundtrip(tmp_path):
+    """Conv/pool/bn models export under the symbolic batch dim too (the
+    actual deployment shape for the image models)."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 16, 16], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=8, filter_size=3, act="relu")
+        c = fluid.layers.batch_norm(c, is_test=True)
+        p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+        out = fluid.layers.fc(p, size=10, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "convmodel")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                      main_program=main, aot=True)
+        X = np.random.RandomState(3).randn(4, 3, 16, 16).astype("float32")
+        want = np.asarray(exe.run(main, feed={"img": X}, fetch_list=[out])[0])
+    predict, _, _ = fluid.io.load_aot_inference_model(d)
+    np.testing.assert_allclose(predict({"img": X})[0], want,
+                               rtol=1e-5, atol=1e-6)
+    # different batch size, same artifact
+    X2 = np.random.RandomState(4).randn(2, 3, 16, 16).astype("float32")
+    assert predict({"img": X2})[0].shape == (2, 10)
+
+
+def test_aot_int8_model_roundtrip(tmp_path):
+    """The int8-quantized inference program (Int8InferenceTranspiler)
+    exports and reloads as an AOT artifact: quantized deployment parity
+    with the reference's int8 C++ predictor path."""
+    from paddle_tpu.contrib.quantize import Int8InferenceTranspiler
+
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 29
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3, act="relu")
+        out = fluid.layers.fc(c, size=6, act="softmax")
+    infer = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "int8model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        Int8InferenceTranspiler().transpile(infer, fluid.global_scope())
+        assert any(op.type.startswith("quantized_")
+                   for op in infer.global_block().ops)
+        X = np.random.RandomState(5).randn(4, 3, 8, 8).astype("float32")
+        want = np.asarray(exe.run(infer, feed={"img": X}, fetch_list=[out])[0])
+        fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                      main_program=infer, aot=True)
+    predict, _, _ = fluid.io.load_aot_inference_model(d)
+    np.testing.assert_allclose(predict({"img": X})[0], want,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_aot_requires_static_nonbatch_dims(tmp_path):
     fluid.unique_name.switch()
     main = fluid.Program()
