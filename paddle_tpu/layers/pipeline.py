@@ -58,6 +58,7 @@ class Pipeline:
         self.in_stage = False
         self._block = None
         self._input = None          # (outer var, stage-local var)
+        self._sides = []            # [(outer var, stage-local var)]
         self._output_local = None
         self._params = []           # [(stacked Parameter, local var name)]
         self._param_locals = {}     # stacked param name -> local var
@@ -109,6 +110,23 @@ class Pipeline:
             shape=(-1,) + tuple(x.shape[1:]) if x.shape else None,
         )
         self._input = (x, local)
+        return local
+
+    def stage_side_input(self, v):
+        """Declare a batch-aligned companion every stage READS but none
+        transforms (attention bias, masks, lengths...).  It is sliced to
+        the in-flight microbatch alongside the activation — closing over
+        the outer full-batch var instead would shape-mismatch the
+        microbatched activation.  Batch-independent tensors (lookup
+        tables, scalars) need no declaration: close over them freely."""
+        if not self.in_stage:
+            raise RuntimeError(
+                "stage_side_input() must be called inside `with pipe.stage()`")
+        local = self._block.create_var(
+            name=self.helper.name + ".side%d" % len(self._sides), dtype=v.dtype,
+            shape=(-1,) + tuple(v.shape[1:]) if v.shape else None,
+        )
+        self._sides.append((v, local))
         return local
 
     def stage_output(self, y):
@@ -181,7 +199,8 @@ class Pipeline:
         )
         parent.append_op(
             type="pipeline",
-            inputs={"X": [outer_x], "Params": [p for p, _ in self._params]},
+            inputs={"X": [outer_x], "Params": [p for p, _ in self._params],
+                    "Sides": [v for v, _ in self._sides]},
             outputs={"Out": [out]},
             attrs={
                 "sub_block": blk.idx,
@@ -190,6 +209,7 @@ class Pipeline:
                 "input_local": local_in.name,
                 "output_local": self._output_local.name,
                 "param_locals": [ln for _, ln in self._params],
+                "side_locals": [lv.name for _, lv in self._sides],
             },
         )
         self.out_var = out

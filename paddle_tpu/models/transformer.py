@@ -210,11 +210,39 @@ def wrap_encoder(
     d_inner=D_INNER,
     dropout=DROPOUT,
     use_flash=False,
+    pipeline_stages=0,
+    pipeline_microbatches=None,
 ):
+    """``pipeline_stages=S`` builds the encoder stack as a layers.Pipeline
+    (n_layer/S layers per stage, stage-stacked params): under
+    ``ParallelExecutor(mesh_shape={"pp": S})`` the stack runs GPipe-style
+    with one stage per device; on one device it runs the identical
+    microbatched sequence.  The pad bias rides along as a per-microbatch
+    side input."""
     pos_table = _const_table("src_pos_enc_table", _position_encoding_table(max_length, d_model))
     src_bias = _pad_bias(src_word)
     src_lens = _word_lens(src_word) if use_flash else None
     x = prepare_encoder_decoder(src_word, src_vocab_size, d_model, max_length, dropout, pos_table, "src_word_emb")
+    if pipeline_stages:
+        if n_layer % pipeline_stages:
+            raise ValueError("n_layer %d %% pipeline_stages %d != 0"
+                             % (n_layer, pipeline_stages))
+        if use_flash:
+            raise ValueError(
+                "use_flash composes with sp, not pp: the flash kernel's "
+                "sequence-parallel path reads the mesh, which inside a "
+                "pipeline stage would nest shard_maps")
+        pipe = layers.Pipeline(
+            num_stages=pipeline_stages,
+            num_microbatches=pipeline_microbatches or 2 * pipeline_stages)
+        with pipe.stage():
+            h = pipe.stage_input(x)
+            bias_l = pipe.stage_side_input(src_bias)
+            for _ in range(n_layer // pipeline_stages):
+                h = encoder_layer(h, bias_l, n_head, d_model // n_head,
+                                  d_model // n_head, d_model, d_inner, dropout)
+            pipe.stage_output(h)
+        return pipe(), src_bias
     for _ in range(n_layer):
         x = encoder_layer(x, src_bias, n_head, d_model // n_head, d_model // n_head, d_model, d_inner, dropout,
                           use_flash=use_flash, kv_lens=src_lens)
@@ -283,11 +311,15 @@ def transformer(
     dropout=DROPOUT,
     label_smooth_eps=0.1,
     use_flash=False,
+    pipeline_stages=0,
+    pipeline_microbatches=None,
 ):
     """Training graph (reference transformer_model.py:282 transformer).
-    Returns (avg_cost, sum_cost, token_count, logits)."""
+    Returns (avg_cost, sum_cost, token_count, logits).  ``pipeline_stages``
+    pipelines the encoder stack (wrap_encoder)."""
     enc_out, src_bias = wrap_encoder(src_word, src_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout,
-                                     use_flash=use_flash)
+                                     use_flash=use_flash, pipeline_stages=pipeline_stages,
+                                     pipeline_microbatches=pipeline_microbatches)
     logits = wrap_decoder(trg_word, enc_out, src_bias, trg_vocab_size, max_length, n_layer, n_head, d_model, d_inner,
                           dropout, use_flash=use_flash, src_word=src_word)
 
@@ -321,6 +353,8 @@ def get_model(
     learning_rate=2.0,
     warmup_steps=8000,
     use_flash=False,
+    pipeline_stages=0,
+    pipeline_microbatches=None,
 ):
     import paddle_tpu as fluid
 
@@ -335,6 +369,8 @@ def get_model(
             src_vocab_size, trg_vocab_size, max_length,
             n_layer, n_head, d_model, d_inner, dropout,
             use_flash=use_flash,
+            pipeline_stages=pipeline_stages,
+            pipeline_microbatches=pipeline_microbatches,
         )
         inference_program = main.clone(for_test=True)
         lr = layers.scale(x=layers.noam_decay(d_model, warmup_steps), scale=float(learning_rate))

@@ -30,11 +30,13 @@ def _pipeline(ctx, op):
 
     x = ctx.get_input(op, "X")
     params = ctx.get_inputs(op, "Params")   # each stacked [S, ...]
+    side_vals = ctx.get_inputs(op, "Sides")  # each [B, ...], microbatch-sliced
     sub = op.sub_block
     a = op.attrs
     S = int(a["num_stages"])
     M = int(a["num_microbatches"])
     locals_ = list(a["param_locals"])
+    side_locals = list(a.get("side_locals") or [])
     in_local, out_local = a["input_local"], a["output_local"]
 
     B = x.shape[0]
@@ -43,10 +45,13 @@ def _pipeline(ctx, op):
             "pipeline batch %d is not divisible by num_microbatches %d"
             % (B, M))
     stacked = dict(zip(locals_, params))
+    sides = dict(zip(side_locals, side_vals)) or None
 
-    def stage_fn(pdict, h):
+    def stage_fn(pdict, h, side_mb=None):
         env2 = dict(ctx.env)
         env2.update(pdict)
+        if side_mb:
+            env2.update(side_mb)
         env2[in_local] = h
         c2 = ctx.child(env2)
         interpret_ops(c2, sub.ops)
@@ -60,18 +65,22 @@ def _pipeline(ctx, op):
     if pp > 1 and pp == S:
         from ..parallel.pipeline import pipeline_apply
 
-        out = pipeline_apply(
-            lambda p, h: stage_fn(p, h), stacked, x, mesh, M, axis_name="pp")
+        out = pipeline_apply(stage_fn, stacked, x, mesh, M, axis_name="pp",
+                             side_inputs=sides)
     else:
         # single-device reference: same microbatch split, stages in sequence
         mb = B // M
         xs = x.reshape((M, mb) + tuple(x.shape[1:]))
+        sides_mb = (
+            {n: v.reshape((M, mb) + tuple(v.shape[1:])) for n, v in sides.items()}
+            if sides else None)
 
-        def run_chain(xm):
-            h = xm
+        def run_chain(args):
+            h, side_mb = args
             for s in range(S):
-                h = stage_fn({n: p[s] for n, p in stacked.items()}, h)
+                h = stage_fn({n: p[s] for n, p in stacked.items()}, h, side_mb)
             return h
 
-        out = jax.lax.map(run_chain, xs).reshape((B,) + tuple(x.shape[1:]))
+        out = jax.lax.map(run_chain, (xs, sides_mb or {}))
+        out = out.reshape((B,) + tuple(x.shape[1:]))
     ctx.set_output(op, "Out", out)

@@ -37,14 +37,22 @@ def pipeline_stage_params(per_stage_params):
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
-                   axis_name="pp"):
+                   axis_name="pp", side_inputs=None):
     """Run ``x`` through the S-stage pipeline.
 
-    stage_fn(params_slice, activation) -> activation, applied S times in
-    sequence semantically; stacked_params has leading dim S (sharded over
-    ``axis_name``); x is the full batch [B, ...] with B % n_microbatches
-    == 0.  Returns the full output batch.  Call under jit (the shard_map
-    is internal).
+    stage_fn(params_slice, activation[, sides]) -> activation, applied S
+    times in sequence semantically; stacked_params has leading dim S
+    (sharded over ``axis_name``); x is the full batch [B, ...] with
+    B % n_microbatches == 0.  Returns the full output batch.  Call under
+    jit (the shard_map is internal).
+
+    ``side_inputs`` (optional pytree of [B, ...] arrays) are batch-aligned
+    companions every stage reads but none transforms — e.g. an attention
+    bias: each stage must see the SLICE belonging to the microbatch it is
+    currently processing (a full-batch closure would shape-mismatch the
+    microbatched activation).  When given, stage_fn is called as
+    stage_fn(params, h, sides) with sides sliced to the in-flight
+    microbatch.
     """
     import jax
     import jax.numpy as jnp
@@ -58,17 +66,24 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
     M = n_microbatches
     mb = B // M
     xs = x.reshape((M, mb) + x.shape[1:])
+    sides = None
+    # an empty pytree ({} from a programmatically-built dict) means absent:
+    # stage_fn keeps its two-arg signature
+    if side_inputs is not None and jax.tree_util.tree_leaves(side_inputs):
+        sides = jax.tree_util.tree_map(
+            lambda a: a.reshape((M, mb) + a.shape[1:]), side_inputs)
 
     # per-device views: params [1, ...] (its own stage), xs replicated
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    side_specs = jax.tree_util.tree_map(lambda _: P(), sides)
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(param_specs, P()),
+        in_specs=(param_specs, P(), side_specs),
         out_specs=P(),
         check_vma=False,
     )
-    def run(params, xs):
+    def run(params, xs, sides):
         idx = jax.lax.axis_index(axis_name)
         my_params = jax.tree_util.tree_map(lambda p: p[0], params)
         perm = [(i, (i + 1) % S) for i in range(S)]
@@ -83,7 +98,15 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
             # 0 * NaN in the VJP
             feed = xs[jnp.minimum(t, M - 1)]
             inp = jnp.where(idx == 0, feed, held)
-            out = stage_fn(my_params, inp)
+            if sides is None:
+                out = stage_fn(my_params, inp)
+            else:
+                # device idx processes microbatch t - idx at tick t (fill
+                # ticks clamp to 0: the activation is discarded garbage,
+                # the slice just has to be shape-right and finite)
+                m = jnp.clip(t - idx, 0, M - 1)
+                side_mb = jax.tree_util.tree_map(lambda a: a[m], sides)
+                out = stage_fn(my_params, inp, side_mb)
             nxt = jax.lax.ppermute(out, axis_name, perm)
             # the LAST stage's output at tick t is microbatch t-(S-1)
             return nxt, out
@@ -98,5 +121,5 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
         mine = jnp.where(idx == S - 1, last, jnp.zeros_like(last))
         return jax.lax.psum(mine, axis_name)
 
-    ys = run(stacked_params, xs)
+    ys = run(stacked_params, xs, sides)
     return ys.reshape((B,) + ys.shape[2:])
