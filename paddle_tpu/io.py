@@ -133,7 +133,9 @@ def save_inference_model(
     api_impl.cc).  The batch dim exports symbolically, so one artifact
     serves any batch size; other dims must be static (override with
     ``aot_feed_shapes={name: shape}``).  ``aot_platforms`` defaults to
-    ("cpu", "tpu") — one artifact runs on either."""
+    ("cpu", "tpu") — one artifact runs on either.  Ragged (lod_level>=1)
+    feeds are not AOT-exportable — their @LENGTHS companions are runtime
+    metadata; use the ``load_inference_model`` jit path for those."""
     main_program = main_program or default_main_program()
     if isinstance(feeded_var_names, str):
         feeded_var_names = [feeded_var_names]

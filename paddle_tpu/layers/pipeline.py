@@ -32,6 +32,13 @@ Example::
 Constraints (the homogeneous-pipeline contract): one activation in, one
 activation out, same shape; every stage runs the same body with its own
 slice of the stacked parameters.
+
+Dropout inside a stage body draws ONE mask per op instance (positional
+PRNG keys), so the same mask applies at every stage and microbatch —
+training remains valid but the regularization noise is correlated;
+prefer dropout on the embedding/head outside the pipeline, or accept
+the correlation (it matches the microbatched sequential path exactly,
+which is what the equivalence tests rely on).
 """
 from __future__ import annotations
 

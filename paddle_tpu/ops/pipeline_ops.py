@@ -19,6 +19,8 @@ loss IS pipeline-parallel backward.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..registry import register
 
 
@@ -40,6 +42,13 @@ def _pipeline(ctx, op):
     in_local, out_local = a["input_local"], a["output_local"]
 
     B = x.shape[0]
+    if not isinstance(B, (int, np.integer)):
+        # symbolic batch (jax.export shape polymorphism): the microbatch
+        # split needs a concrete B — AOT-export pipelined models with
+        # save_inference_model(..., aot_feed_shapes={name: full_shape})
+        raise ValueError(
+            "pipeline needs a concrete batch dim, got symbolic %r; for AOT "
+            "export pass aot_feed_shapes with a static batch size" % (B,))
     if B % M:
         raise ValueError(
             "pipeline batch %d is not divisible by num_microbatches %d"

@@ -129,6 +129,68 @@ def test_aot_int8_model_roundtrip(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_aot_embedding_model_int64_feeds(tmp_path):
+    """int64 token feeds (embedding models) export and predict; the CLI
+    casts loaded arrays to the exported dtypes."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 37
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.data(name="w", shape=[6], dtype="int64")
+        emb = fluid.layers.embedding(w, size=[50, 16])
+        pooled = fluid.layers.reduce_mean(emb, dim=1)
+        out = fluid.layers.fc(pooled, size=5, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "embmodel")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["w"], [out], exe,
+                                      main_program=main, aot=True)
+        W = np.random.RandomState(6).randint(0, 50, size=(3, 6)).astype("int64")
+        want = np.asarray(exe.run(main, feed={"w": W}, fetch_list=[out])[0])
+    predict, _, _ = fluid.io.load_aot_inference_model(d)
+    np.testing.assert_allclose(predict({"w": W})[0], want, rtol=1e-6, atol=1e-7)
+
+
+def test_aot_pipelined_model_static_batch(tmp_path):
+    """A layers.Pipeline model AOT-exports with a STATIC batch override
+    (the microbatch split needs concrete B); symbolic batch raises the
+    documented error."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 41
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        pipe = fluid.layers.Pipeline(num_stages=2, num_microbatches=2)
+        with pipe.stage():
+            h = pipe.stage_input(x)
+            o = fluid.layers.fc(h, size=8, act="tanh")
+            pipe.stage_output(o)
+        out = fluid.layers.fc(pipe(), size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "pipemodel")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        try:
+            fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                          main_program=main, aot=True)
+            symbolic_ok = True
+        except ValueError as e:
+            symbolic_ok = False
+            assert "static batch" in str(e)
+        assert not symbolic_ok
+        fluid.io.save_inference_model(
+            d, ["x"], [out], exe, main_program=main, aot=True,
+            aot_feed_shapes={"x": (4, 8)})
+        X = np.random.RandomState(7).randn(4, 8).astype("float32")
+        want = np.asarray(exe.run(main, feed={"x": X}, fetch_list=[out])[0])
+    predict, _, _ = fluid.io.load_aot_inference_model(d)
+    np.testing.assert_allclose(predict({"x": X})[0], want,
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_aot_requires_static_nonbatch_dims(tmp_path):
     fluid.unique_name.switch()
     main = fluid.Program()

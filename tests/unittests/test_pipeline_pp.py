@@ -124,7 +124,7 @@ def test_pipeline_backward_grads_flow_every_stage():
 
 
 def test_pipeline_program_roundtrip_keeps_stacked_flag():
-    main, _, _ = _build()
+    main, startup, loss = _build()
     clone = fluid.Program.parse_from_string(main.to_string())
     params = [v for v in clone.global_block().vars.values()
               if getattr(v, "pp_stacked", False)]
@@ -134,6 +134,19 @@ def test_pipeline_program_roundtrip_keeps_stacked_flag():
     assert all(
         getattr(test_clone.global_block().vars[p.name], "pp_stacked", False)
         for p in params)
+    # the roundtripped program must also RUN (sub-block, local vars, and
+    # pipeline attrs all survive serialization) with identical numerics
+    X, Y = _data(seed=6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        want = float(np.ravel(exe.run(main, feed={"x": X, "y": Y},
+                                      fetch_list=[loss])[0])[0])
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = float(np.ravel(exe.run(clone, feed={"x": X, "y": Y},
+                                     fetch_list=[loss.name])[0])[0])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
 def test_pipeline_composes_with_dp_axis():
