@@ -1,0 +1,78 @@
+"""layers.Pipeline composed with the rest of the training stack:
+activation recompute, global-norm gradient clipping, and weight decay all
+produce identical numerics on the pp mesh and the sequential path."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+S, M, D = 4, 4, 8
+
+
+def _feeds(batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(batch, D).astype("float32"),
+            "y": rng.randn(batch, D).astype("float32")}
+
+
+def _build(recompute=False, clip=False, decay=False):
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 43
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[D], dtype="float32")
+        pipe = fluid.layers.Pipeline(num_stages=S, num_microbatches=M)
+        with pipe.stage():
+            h = pipe.stage_input(x)
+            pa = (fluid.ParamAttr(
+                regularizer=fluid.regularizer.L2Decay(1e-3))
+                if decay else None)
+            o = fluid.layers.fc(h, size=D, act="tanh", param_attr=pa)
+            pipe.stage_output(o)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pipe(), label=y))
+        if clip:
+            fluid.clip.set_gradient_clip(
+                fluid.clip.GradientClipByGlobalNorm(clip_norm=0.1))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    if recompute:
+        main.enable_recompute(segments=2)
+    return main, startup, loss
+
+
+def _run(mesh, feeds, steps=3, **build_kw):
+    from test_pipeline_pp import _run_losses  # shared harness
+
+    return _run_losses(lambda: _build(**build_kw), mesh,
+                       feeds["x"], feeds["y"], steps)
+
+
+def test_pipeline_with_recompute_matches():
+    feeds = _feeds(seed=1)
+    seq = _run(None, feeds, recompute=True)
+    pp = _run({"dp": 1, "pp": S}, feeds, recompute=True)
+    plain = _run(None, feeds, recompute=False)
+    np.testing.assert_allclose(pp, seq, rtol=2e-4, atol=1e-6)
+    # recompute must not change numerics either
+    np.testing.assert_allclose(seq, plain, rtol=1e-5, atol=1e-7)
+
+
+def test_pipeline_with_global_norm_clip_matches():
+    feeds = _feeds(seed=2)
+    seq = _run(None, feeds, clip=True)
+    pp = _run({"dp": 1, "pp": S}, feeds, clip=True)
+    np.testing.assert_allclose(pp, seq, rtol=2e-4, atol=1e-6)
+    # the clip actually engaged (different trajectory from unclipped)
+    unclipped = _run(None, feeds, clip=False)
+    assert not np.allclose(seq, unclipped)
+
+
+def test_pipeline_with_weight_decay_matches():
+    feeds = _feeds(seed=3)
+    seq = _run(None, feeds, decay=True)
+    pp = _run({"dp": 1, "pp": S}, feeds, decay=True)
+    np.testing.assert_allclose(pp, seq, rtol=2e-4, atol=1e-6)
+    no_decay = _run(None, feeds, decay=False)
+    assert not np.allclose(seq, no_decay)
