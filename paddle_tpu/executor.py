@@ -951,9 +951,14 @@ class Executor:
                         if getattr(v, "pp_stacked", False)
                     }
                     if stacked:
+                        # leading dim == pp (plain GPipe) or a multiple of
+                        # it (circular: L = pp * repeats rows, device-major
+                        # layout — each device's slices are contiguous)
                         pp_shard = NamedSharding(mesh, P("pp"))
                         for n, v in state.items():
-                            if np.ndim(v) < 1 or np.shape(v)[0] != pp_size:
+                            if (np.ndim(v) < 1
+                                    or np.shape(v)[0] < pp_size
+                                    or np.shape(v)[0] % pp_size):
                                 continue
                             if n in stacked or any(
                                     n.startswith(s + "_") for s in stacked):

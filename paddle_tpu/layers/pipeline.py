@@ -56,12 +56,31 @@ def active_pipeline():
 
 
 class Pipeline:
-    def __init__(self, num_stages, num_microbatches=None, name=None):
+    def __init__(self, num_stages, num_microbatches=None, name=None,
+                 circular_repeats=1):
+        """``circular_repeats=R`` opts into the interleaved (circular)
+        schedule: the ``num_stages`` virtual stages run on num_stages/R
+        devices, each hosting R stage slices — same device count, ~R x
+        smaller pipeline bubble (parallel/pipeline.py
+        pipeline_apply_circular)."""
         if int(num_stages) < 1:
             raise ValueError("num_stages must be >= 1, got %s" % (num_stages,))
+        if int(circular_repeats) < 1 or int(num_stages) % int(circular_repeats):
+            raise ValueError(
+                "circular_repeats %s must divide num_stages %s"
+                % (circular_repeats, num_stages))
         self.helper = LayerHelper("pipeline", name=name)
         self.num_stages = int(num_stages)
+        self.circular_repeats = int(circular_repeats)
         self.num_microbatches = int(num_microbatches or num_stages)
+        n_dev = self.num_stages // self.circular_repeats
+        if self.circular_repeats > 1 and self.num_microbatches % n_dev:
+            raise ValueError(
+                "num_microbatches %d must be a multiple of the pp device "
+                "count %d (= num_stages %d / circular_repeats %d): the "
+                "circular schedule streams microbatches in waves of the "
+                "device count" % (self.num_microbatches, n_dev,
+                                  self.num_stages, self.circular_repeats))
         self.in_stage = False
         self._block = None
         self._input = None          # (outer var, stage-local var)
@@ -217,6 +236,7 @@ class Pipeline:
                 "output_local": self._output_local.name,
                 "param_locals": [ln for _, ln in self._params],
                 "side_locals": [lv.name for _, lv in self._sides],
+                "circular_repeats": self.circular_repeats,
             },
         )
         self.out_var = out

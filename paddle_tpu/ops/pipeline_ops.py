@@ -35,8 +35,10 @@ def _pipeline(ctx, op):
     side_vals = ctx.get_inputs(op, "Sides")  # each [B, ...], microbatch-sliced
     sub = op.sub_block
     a = op.attrs
-    S = int(a["num_stages"])
+    S = int(a["num_stages"])          # VIRTUAL stages (L)
     M = int(a["num_microbatches"])
+    R = int(a.get("circular_repeats", 1))
+    n_dev = S // R                    # physical pp devices the schedule wants
     locals_ = list(a["param_locals"])
     side_locals = list(a.get("side_locals") or [])
     in_local, out_local = a["input_local"], a["output_local"]
@@ -71,13 +73,23 @@ def _pipeline(ctx, op):
     if mesh is not None:
         pp = int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 0))
 
-    if pp > 1 and pp == S:
+    from ..parallel.pipeline import circular_stage_index
+
+    if pp > 1 and R > 1 and pp == n_dev:
+        from ..parallel.pipeline import pipeline_apply_circular
+
+        out = pipeline_apply_circular(
+            stage_fn, stacked, x, mesh, M, R, axis_name="pp",
+            side_inputs=sides)
+    elif pp > 1 and R == 1 and pp == S:
         from ..parallel.pipeline import pipeline_apply
 
         out = pipeline_apply(stage_fn, stacked, x, mesh, M, axis_name="pp",
                              side_inputs=sides)
     else:
         # single-device reference: same microbatch split, stages in sequence
+        # (virtual stage v reads the device-major row under the circular
+        # layout so both paths see identical weights)
         mb = B // M
         xs = x.reshape((M, mb) + tuple(x.shape[1:]))
         sides_mb = (
@@ -86,8 +98,9 @@ def _pipeline(ctx, op):
 
         def run_chain(args):
             h, side_mb = args
-            for s in range(S):
-                h = stage_fn({n: p[s] for n, p in stacked.items()}, h, side_mb)
+            for v in range(S):
+                i = circular_stage_index(v, n_dev, R) if R > 1 else v
+                h = stage_fn({n: p[i] for n, p in stacked.items()}, h, side_mb)
             return h
 
         out = jax.lax.map(run_chain, (xs, sides_mb or {}))

@@ -25,7 +25,8 @@ def __getattr__(name):
     if name in ("ulysses_attention", "ulysses_attention_sharded"):
         ul = importlib.import_module(__name__ + ".ulysses")
         return getattr(ul, name)
-    if name in ("pipeline_apply", "pipeline_stage_params"):
+    if name in ("pipeline_apply", "pipeline_apply_circular",
+                "pipeline_stage_params", "circular_stage_index"):
         pl = importlib.import_module(__name__ + ".pipeline")
         return getattr(pl, name)
     if name in ("switch_moe", "moe_expert_params"):

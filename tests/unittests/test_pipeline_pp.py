@@ -247,6 +247,42 @@ def test_pipeline_transformer_encoder_flagship():
     np.testing.assert_allclose(pp_losses, seq_losses, rtol=5e-4, atol=1e-5)
 
 
+def test_pipeline_circular_schedule_matches_sequential():
+    """circular_repeats=2: 4 virtual stages on a 2-device pp mesh (each
+    device hosts 2 slices, ~2x smaller bubble) — losses and post-training
+    params match the sequential path exactly."""
+    L, R = 4, 2
+
+    def build():
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        startup.random_seed = 47
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[D], dtype="float32")
+            pipe = fluid.layers.Pipeline(num_stages=L, num_microbatches=4,
+                                         circular_repeats=R)
+            with pipe.stage():
+                h = pipe.stage_input(x)
+                o = fluid.layers.fc(h, size=D, act="tanh")
+                pipe.stage_output(o)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pipe(), label=y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    X, Y = _data(batch=16, seed=9)
+    seq, seq_params = _run_losses(build, None, X, Y, 4, collect_params=True)
+    pp, pp_params = _run_losses(build, {"dp": 1, "pp": L // R}, X, Y, 4,
+                                collect_params=True)
+    np.testing.assert_allclose(pp, seq, rtol=2e-4, atol=1e-6)
+    for n, want in seq_params.items():
+        assert want.shape[0] == L  # all virtual stages stacked
+        np.testing.assert_allclose(pp_params[n], want, rtol=5e-4, atol=1e-6,
+                                   err_msg=n)
+    assert seq[-1] < seq[0]
+
+
 def test_pipeline_under_trainer():
     """Trainer(parallel={'pp': S}) drives the same GPipe schedule: losses
     match a single-device Trainer step for step."""
