@@ -212,13 +212,17 @@ def wrap_encoder(
     use_flash=False,
     pipeline_stages=0,
     pipeline_microbatches=None,
+    pipeline_circular_repeats=1,
 ):
     """``pipeline_stages=S`` builds the encoder stack as a layers.Pipeline
     (n_layer/S layers per stage, stage-stacked params): under
     ``ParallelExecutor(mesh_shape={"pp": S})`` the stack runs GPipe-style
     with one stage per device; on one device it runs the identical
     microbatched sequence.  The pad bias rides along as a per-microbatch
-    side input."""
+    side input.  ``pipeline_circular_repeats=R`` (must divide S; the mesh
+    then carries S/R pp devices and microbatches come in multiples of
+    S/R) opts into the interleaved circular schedule — R stage slices per
+    device, bubble (S/R - 1)/(M*R + S/R - 1)."""
     pos_table = _const_table("src_pos_enc_table", _position_encoding_table(max_length, d_model))
     src_bias = _pad_bias(src_word)
     src_lens = _word_lens(src_word) if use_flash else None
@@ -234,7 +238,8 @@ def wrap_encoder(
                 "pipeline stage would nest shard_maps")
         pipe = layers.Pipeline(
             num_stages=pipeline_stages,
-            num_microbatches=pipeline_microbatches or 2 * pipeline_stages)
+            num_microbatches=pipeline_microbatches or 2 * pipeline_stages,
+            circular_repeats=pipeline_circular_repeats)
         with pipe.stage():
             h = pipe.stage_input(x)
             bias_l = pipe.stage_side_input(src_bias)
@@ -313,13 +318,15 @@ def transformer(
     use_flash=False,
     pipeline_stages=0,
     pipeline_microbatches=None,
+    pipeline_circular_repeats=1,
 ):
     """Training graph (reference transformer_model.py:282 transformer).
     Returns (avg_cost, sum_cost, token_count, logits).  ``pipeline_stages``
     pipelines the encoder stack (wrap_encoder)."""
     enc_out, src_bias = wrap_encoder(src_word, src_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout,
                                      use_flash=use_flash, pipeline_stages=pipeline_stages,
-                                     pipeline_microbatches=pipeline_microbatches)
+                                     pipeline_microbatches=pipeline_microbatches,
+                                     pipeline_circular_repeats=pipeline_circular_repeats)
     logits = wrap_decoder(trg_word, enc_out, src_bias, trg_vocab_size, max_length, n_layer, n_head, d_model, d_inner,
                           dropout, use_flash=use_flash, src_word=src_word)
 
@@ -355,6 +362,7 @@ def get_model(
     use_flash=False,
     pipeline_stages=0,
     pipeline_microbatches=None,
+    pipeline_circular_repeats=1,
 ):
     import paddle_tpu as fluid
 
@@ -371,6 +379,7 @@ def get_model(
             use_flash=use_flash,
             pipeline_stages=pipeline_stages,
             pipeline_microbatches=pipeline_microbatches,
+            pipeline_circular_repeats=pipeline_circular_repeats,
         )
         inference_program = main.clone(for_test=True)
         lr = layers.scale(x=layers.noam_decay(d_model, warmup_steps), scale=float(learning_rate))

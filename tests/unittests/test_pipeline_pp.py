@@ -191,12 +191,10 @@ def test_pipeline_transformer_block_stage():
     assert seq[-1] < seq[0]
 
 
-def test_pipeline_transformer_encoder_flagship():
-    """The flagship transformer with a PIPELINED encoder stack
-    (models/transformer.get_model(pipeline_stages=2)): real multi-head
-    attention + pad-bias side input per stage, trained under
-    ParallelExecutor({'pp': 2}) with numerics matching the identical
-    pipelined program on one device."""
+def _transformer_pp_losses(n_layer, stages, microbatches, repeats, mesh,
+                           data_seed, check_stacked=0):
+    """Shared flagship-transformer pp harness: build with the given
+    pipeline config, train 2-3 steps, return per-step losses."""
     from paddle_tpu.models import transformer as T
 
     seq, dm = 8, 16
@@ -205,45 +203,60 @@ def test_pipeline_transformer_encoder_flagship():
         fluid.unique_name.switch()
         model = T.get_model(
             batch_size=4, seq_len=seq, src_vocab_size=32, trg_vocab_size=32,
-            max_length=seq, n_layer=2, n_head=2, d_model=dm, d_inner=32,
-            dropout=0.0, pipeline_stages=2, pipeline_microbatches=2,
+            max_length=seq, n_layer=n_layer, n_head=2, d_model=dm, d_inner=32,
+            dropout=0.0, pipeline_stages=stages,
+            pipeline_microbatches=microbatches,
+            pipeline_circular_repeats=repeats,
         )
         return model["main"], model["startup"], model["loss"]
 
-    # encoder params are stage-stacked
-    main, _, _ = build()
-    stacked = [p for p in main.global_block().all_parameters()
-               if getattr(p, "pp_stacked", False)]
-    assert len(stacked) >= 6  # qkv+out proj, 2 ffn, 2 layer_norm per stage
-    assert all(p.shape[0] == 2 for p in stacked)
+    if check_stacked:
+        main, _, _ = build()
+        stacked = [p for p in main.global_block().all_parameters()
+                   if getattr(p, "pp_stacked", False)]
+        assert len(stacked) >= 6  # qkv+out proj, 2 ffn, 2 layer_norm
+        assert all(p.shape[0] == check_stacked for p in stacked)
 
-    rng = np.random.RandomState(8)
+    rng = np.random.RandomState(8 + data_seed)
     feeds = {n: rng.randint(1, 32, size=(4, seq)).astype("int64")
              for n in ("src_word", "trg_word", "lbl_word")}
 
-    def run(mesh):
-        main, startup, loss = build()
-        exe = fluid.Executor(fluid.CPUPlace())
-        with fluid.scope_guard(fluid.Scope()):
-            np.random.seed(77)
-            exe.run(startup)
-            runner = (fluid.ParallelExecutor(loss_name=loss.name,
-                                             main_program=main,
-                                             mesh_shape=mesh)
-                      if mesh else exe)
-            out = []
-            for _ in range(3):
-                if mesh:
-                    vals = runner.run(fetch_list=[loss], feed=feeds)
-                else:
-                    vals = exe.run(main, feed=feeds, fetch_list=[loss])
-                out.append(float(np.ravel(vals[0]).mean()))
-        return out
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        np.random.seed(77)
+        exe.run(startup)
+        runner = (fluid.ParallelExecutor(loss_name=loss.name,
+                                         main_program=main, mesh_shape=mesh)
+                  if mesh else exe)
+        out = []
+        for _ in range(3):
+            vals = (runner.run(fetch_list=[loss], feed=feeds) if mesh
+                    else exe.run(main, feed=feeds, fetch_list=[loss]))
+            out.append(float(np.ravel(vals[0]).mean()))
+    return out
 
-    seq_losses = run(None)
-    pp_losses = run({"dp": 1, "pp": 2})
+
+def test_pipeline_transformer_encoder_flagship():
+    """The flagship transformer with a PIPELINED encoder stack
+    (models/transformer.get_model(pipeline_stages=2)): real multi-head
+    attention + pad-bias side input per stage, trained under
+    ParallelExecutor({'pp': 2}) with numerics matching the identical
+    pipelined program on one device."""
+    seq_losses = _transformer_pp_losses(2, 2, 2, 1, None, 0, check_stacked=2)
+    pp_losses = _transformer_pp_losses(2, 2, 2, 1, {"dp": 1, "pp": 2}, 0)
     assert np.isfinite(seq_losses).all()
     assert seq_losses[-1] < seq_losses[0]  # Adam is learning
+    np.testing.assert_allclose(pp_losses, seq_losses, rtol=5e-4, atol=1e-5)
+
+
+def test_pipeline_transformer_encoder_circular():
+    """Flagship transformer under the CIRCULAR schedule: 4 encoder layers
+    as 4 virtual stages on a 2-device pp mesh (repeats=2) — attention +
+    pad-bias side inputs indexed by the streaming wave schedule — matches
+    sequential."""
+    seq_losses = _transformer_pp_losses(4, 4, 4, 2, None, 4)
+    pp_losses = _transformer_pp_losses(4, 4, 4, 2, {"dp": 1, "pp": 2}, 4)
     np.testing.assert_allclose(pp_losses, seq_losses, rtol=5e-4, atol=1e-5)
 
 
