@@ -132,6 +132,28 @@ def canonical_dtype(dtype) -> str:
     return _DTYPE_ALIASES[name]
 
 
+def safe_import_jax():
+    """Import jax with the ambient np.random state preserved.
+
+    The FIRST ``import jax`` in a process consumes np.random draws during
+    import, so a user's ``np.random.seed(N)`` placed before the import
+    would pin a DIFFERENT startup draw than the same seed placed after it
+    (first-run-vs-later-runs nondeterminism).  Every lazy jax import on a
+    user-facing entry path goes through here; tests/unittests/
+    test_first_run_determinism.py is the regression."""
+    import sys
+
+    if "jax" in sys.modules:
+        import jax
+
+        return jax
+    state = np.random.get_state()
+    import jax
+
+    np.random.set_state(state)
+    return jax
+
+
 def np_dtype(dtype):
     name = canonical_dtype(dtype)
     if name == "bfloat16":

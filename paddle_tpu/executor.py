@@ -640,8 +640,9 @@ class Executor:
     _CACHE_CAP = 64  # compiled (program, shapes) entries kept per executor
 
     def __init__(self, place=None):
-        from .core import TPUPlace
+        from .core import TPUPlace, safe_import_jax
 
+        safe_import_jax()  # first jax import eats np.random state otherwise
         self.place = place if place is not None else TPUPlace()
         self._cache: dict = {}
         # set by ParallelExecutor: jax.sharding.Mesh for data-parallel SPMD;
@@ -856,8 +857,15 @@ class Executor:
         return state
 
     def _rng_key(self, program, scope):
-        import jax
+        # core.safe_import_jax: the FIRST `import jax` in a process consumes
+        # ambient np.random state during import, which would make the very
+        # first run's seed draw differ from every later run's under the
+        # same np.random.seed (observed: first-call init != later-call
+        # init).  The guarded import keeps `np.random.seed(N)` pinning the
+        # startup draw regardless of import timing.
+        from .core import safe_import_jax
 
+        jax = safe_import_jax()
         owner = scope._owner("__rng_key__")
         k = owner.vars["__rng_key__"] if owner is not None else None
         if k is None:

@@ -269,7 +269,14 @@ def wrap_decoder(
     causal=True,
     use_flash=False,
     src_word=None,
+    pipeline_stages=0,
+    pipeline_microbatches=None,
+    pipeline_circular_repeats=1,
 ):
+    """``pipeline_stages`` pipelines the decoder stack like wrap_encoder's
+    (training graph only — incremental decode with ``caches`` keeps the
+    sequential stack): enc_out and both attention biases ride as
+    per-microbatch side inputs."""
     pos_table = _const_table("trg_pos_enc_table", _position_encoding_table(max_length, d_model))
     seq_len = trg_word.shape[1]
     trg_lens = _word_lens(trg_word) if use_flash else None
@@ -281,23 +288,48 @@ def wrap_decoder(
         causal_bias = layers.unsqueeze(causal_bias, axes=[0, 1])  # [1,1,T,T]
         slf_bias = layers.elementwise_add(x=causal_bias, y=slf_bias)
     x = prepare_encoder_decoder(trg_word, trg_vocab_size, d_model, max_length, dropout, pos_table, "trg_word_emb")
-    for i in range(n_layer):
-        x = decoder_layer(
-            x,
-            enc_out,
-            slf_bias,
-            src_bias,
-            n_head,
-            d_model // n_head,
-            d_model // n_head,
-            d_model,
-            d_inner,
-            dropout,
-            cache=caches[i] if caches is not None else None,
-            use_flash=use_flash and caches is None and causal,
-            trg_lens=trg_lens,
-            src_lens=src_lens,
-        )
+    if pipeline_stages and caches is None:
+        if n_layer % pipeline_stages:
+            raise ValueError("n_layer %d %% pipeline_stages %d != 0"
+                             % (n_layer, pipeline_stages))
+        if use_flash:
+            raise ValueError(
+                "use_flash composes with sp, not pp (see wrap_encoder)")
+        pipe = layers.Pipeline(
+            num_stages=pipeline_stages,
+            num_microbatches=pipeline_microbatches or 2 * pipeline_stages,
+            circular_repeats=pipeline_circular_repeats)
+        with pipe.stage():
+            h = pipe.stage_input(x)
+            enc_l = pipe.stage_side_input(enc_out)
+            # [B,1,T,T] at runtime (causal [1,1,T,T] broadcast over the
+            # [B,1,1,T] pad bias): batch-leading, slices per microbatch
+            slf_l = pipe.stage_side_input(slf_bias)
+            src_l = pipe.stage_side_input(src_bias)
+            for _ in range(n_layer // pipeline_stages):
+                h = decoder_layer(
+                    h, enc_l, slf_l, src_l, n_head, d_model // n_head,
+                    d_model // n_head, d_model, d_inner, dropout)
+            pipe.stage_output(h)
+        x = pipe()
+    else:
+        for i in range(n_layer):
+            x = decoder_layer(
+                x,
+                enc_out,
+                slf_bias,
+                src_bias,
+                n_head,
+                d_model // n_head,
+                d_model // n_head,
+                d_model,
+                d_inner,
+                dropout,
+                cache=caches[i] if caches is not None else None,
+                use_flash=use_flash and caches is None and causal,
+                trg_lens=trg_lens,
+                src_lens=src_lens,
+            )
     logits = layers.fc(input=x, size=trg_vocab_size, num_flatten_dims=2, bias_attr=False)
     return logits
 
@@ -322,13 +354,17 @@ def transformer(
 ):
     """Training graph (reference transformer_model.py:282 transformer).
     Returns (avg_cost, sum_cost, token_count, logits).  ``pipeline_stages``
-    pipelines the encoder stack (wrap_encoder)."""
+    pipelines BOTH the encoder and decoder stacks (wrap_encoder /
+    wrap_decoder) — two stage-stacked parameter sets."""
     enc_out, src_bias = wrap_encoder(src_word, src_vocab_size, max_length, n_layer, n_head, d_model, d_inner, dropout,
                                      use_flash=use_flash, pipeline_stages=pipeline_stages,
                                      pipeline_microbatches=pipeline_microbatches,
                                      pipeline_circular_repeats=pipeline_circular_repeats)
     logits = wrap_decoder(trg_word, enc_out, src_bias, trg_vocab_size, max_length, n_layer, n_head, d_model, d_inner,
-                          dropout, use_flash=use_flash, src_word=src_word)
+                          dropout, use_flash=use_flash, src_word=src_word,
+                          pipeline_stages=pipeline_stages,
+                          pipeline_microbatches=pipeline_microbatches,
+                          pipeline_circular_repeats=pipeline_circular_repeats)
 
     label = layers.one_hot(input=lbl_word, depth=trg_vocab_size)
     if label_smooth_eps:

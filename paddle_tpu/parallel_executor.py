@@ -65,7 +65,9 @@ class BuildStrategy:
 def build_mesh(mesh_shape=None, devices=None):
     """(dp, tp[, sp]) tuple / {axis: size} dict / None -> jax Mesh.
     None or True means a 1-D data-parallel mesh over all devices."""
-    import jax
+    from .core import safe_import_jax
+
+    jax = safe_import_jax()
     from jax.sharding import Mesh
 
     devs = list(devices if devices is not None else jax.devices())
@@ -102,7 +104,9 @@ class ParallelExecutor:
         mesh_shape=None,
         sharding_rules=None,
     ):
-        import jax
+        from .core import safe_import_jax
+
+        jax = safe_import_jax()
 
         self._program = main_program or default_main_program()
         self._loss_name = loss_name

@@ -257,6 +257,8 @@ def test_pipeline_transformer_encoder_circular():
     sequential."""
     seq_losses = _transformer_pp_losses(4, 4, 4, 2, None, 4)
     pp_losses = _transformer_pp_losses(4, 4, 4, 2, {"dp": 1, "pp": 2}, 4)
+    assert np.isfinite(seq_losses).all(), seq_losses  # allclose(NaN,NaN) passes
+    assert seq_losses[-1] < seq_losses[0]
     np.testing.assert_allclose(pp_losses, seq_losses, rtol=5e-4, atol=1e-5)
 
 
