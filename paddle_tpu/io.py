@@ -215,7 +215,9 @@ def load_aot_inference_model(dirname):
     compiled executable (weights baked in; batch size free).  The
     standalone CLI ``tools/predict.py`` does the same with only
     jax + numpy on the path."""
-    import jax
+    from .core import safe_import_jax
+
+    jax = safe_import_jax()
     from jax import export as jax_export
 
     with open(os.path.join(dirname, "__aot_meta__")) as f:

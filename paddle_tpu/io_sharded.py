@@ -34,7 +34,9 @@ def _checkpointer():
 
 def save_sharded(dirname, state, step=0):
     """Write one step-versioned sharded checkpoint of {name: array}."""
-    import jax
+    from .core import safe_import_jax
+
+    jax = safe_import_jax()
 
     path = os.path.abspath(os.path.join(dirname, "step_%d" % int(step)))
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -73,7 +75,9 @@ def load_sharded(dirname, step=None, template=None):
     """Restore {name: array}.  With ``template`` (a state dict of arrays
     whose shardings describe the target layout), each array is restored
     directly INTO that sharding — every host reads only its shards."""
-    import jax
+    from .core import safe_import_jax
+
+    jax = safe_import_jax()
     import orbax.checkpoint as ocp
 
     step = latest_step(dirname) if step is None else int(step)

@@ -39,6 +39,11 @@ training remains valid but the regularization noise is correlated;
 prefer dropout on the embedding/head outside the pipeline, or accept
 the correlation (it matches the microbatched sequential path exactly,
 which is what the equivalence tests rely on).
+
+Ragged (LoD) tensors are not microbatch-sliced: @LENGTHS companions of
+outer vars are closed over at full batch size, so sequence ops inside a
+stage body would mix batch scopes — keep stage bodies dense (pad-mask
+via side inputs, as the transformer integration does).
 """
 from __future__ import annotations
 

@@ -159,6 +159,22 @@ def prepare_encoder_decoder(
     return out
 
 
+def _make_pipe(n_layer, stages, microbatches, repeats, use_flash, what):
+    """Shared guard-and-construct for the pipelined encoder/decoder stacks."""
+    if n_layer % stages:
+        raise ValueError("%s n_layer %d %% pipeline_stages %d != 0"
+                         % (what, n_layer, stages))
+    if use_flash:
+        raise ValueError(
+            "use_flash composes with sp, not pp: the flash kernel's "
+            "sequence-parallel path reads the mesh, which inside a "
+            "pipeline stage would nest shard_maps")
+    return layers.Pipeline(
+        num_stages=stages,
+        num_microbatches=microbatches or 2 * stages,
+        circular_repeats=repeats)
+
+
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner, dropout,
                   use_flash=False, kv_lens=None):
     attn = multi_head_attention(x, None, None, attn_bias, d_key, d_value, d_model, n_head, dropout,
@@ -228,18 +244,8 @@ def wrap_encoder(
     src_lens = _word_lens(src_word) if use_flash else None
     x = prepare_encoder_decoder(src_word, src_vocab_size, d_model, max_length, dropout, pos_table, "src_word_emb")
     if pipeline_stages:
-        if n_layer % pipeline_stages:
-            raise ValueError("n_layer %d %% pipeline_stages %d != 0"
-                             % (n_layer, pipeline_stages))
-        if use_flash:
-            raise ValueError(
-                "use_flash composes with sp, not pp: the flash kernel's "
-                "sequence-parallel path reads the mesh, which inside a "
-                "pipeline stage would nest shard_maps")
-        pipe = layers.Pipeline(
-            num_stages=pipeline_stages,
-            num_microbatches=pipeline_microbatches or 2 * pipeline_stages,
-            circular_repeats=pipeline_circular_repeats)
+        pipe = _make_pipe(n_layer, pipeline_stages, pipeline_microbatches,
+                          pipeline_circular_repeats, use_flash, "encoder")
         with pipe.stage():
             h = pipe.stage_input(x)
             bias_l = pipe.stage_side_input(src_bias)
@@ -289,16 +295,8 @@ def wrap_decoder(
         slf_bias = layers.elementwise_add(x=causal_bias, y=slf_bias)
     x = prepare_encoder_decoder(trg_word, trg_vocab_size, d_model, max_length, dropout, pos_table, "trg_word_emb")
     if pipeline_stages and caches is None:
-        if n_layer % pipeline_stages:
-            raise ValueError("n_layer %d %% pipeline_stages %d != 0"
-                             % (n_layer, pipeline_stages))
-        if use_flash:
-            raise ValueError(
-                "use_flash composes with sp, not pp (see wrap_encoder)")
-        pipe = layers.Pipeline(
-            num_stages=pipeline_stages,
-            num_microbatches=pipeline_microbatches or 2 * pipeline_stages,
-            circular_repeats=pipeline_circular_repeats)
+        pipe = _make_pipe(n_layer, pipeline_stages, pipeline_microbatches,
+                          pipeline_circular_repeats, use_flash, "decoder")
         with pipe.stage():
             h = pipe.stage_input(x)
             enc_l = pipe.stage_side_input(enc_out)

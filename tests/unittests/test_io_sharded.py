@@ -50,3 +50,20 @@ def test_overwrite_same_step(tmp_path):
     save_sharded(str(tmp_path), {"x": np.arange(3.0) + 5}, step=0)
     got = load_sharded(str(tmp_path), step=0)
     np.testing.assert_array_equal(np.asarray(got["x"]), np.arange(3.0) + 5)
+
+
+def test_pp_stacked_state_roundtrip(tmp_path):
+    """Pipeline-stacked parameters sharded over a pp axis checkpoint and
+    restore with their shardings (the pp training state path)."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    w = jax.device_put(
+        jnp.arange(4 * 3 * 3, dtype=jnp.float32).reshape(4, 3, 3),
+        NamedSharding(mesh, P("pp")))          # [S, din, dout] stage-stacked
+    mom = jax.device_put(jnp.ones((4, 3, 3), jnp.float32) * 0.5,
+                         NamedSharding(mesh, P("pp")))  # optimizer accumulator
+    state = {"pipe.w": w, "pipe.w_moment_0": mom}
+    save_sharded(str(tmp_path), state, step=2)
+    restored = load_sharded(str(tmp_path), template=state)
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(restored[k]), np.asarray(state[k]))
+    assert restored["pipe.w"].sharding.spec == P("pp")

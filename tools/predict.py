@@ -47,8 +47,13 @@ def main(argv=None):
     if missing:
         ap.error("missing feeds: %s" % missing)
 
+    # standalone file (no paddle_tpu import): inline the first-import
+    # guard — `import jax` consumes ambient np.random state on first import
+    _rng_state = np.random.get_state()
     import jax
     from jax import export as jax_export
+
+    np.random.set_state(_rng_state)
 
     with open(os.path.join(args.model_dir, "__aot__"), "rb") as f:
         exported = jax_export.deserialize(bytearray(f.read()))
