@@ -223,41 +223,52 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
 
     jitted = jax.jit(step, donate_argnums=(0,))
 
-    # infinite-epoch pipeline; a prefetch thread keeps device_put ahead of
-    # the compute stream (double buffering over the tunnel/PCIe)
+    # infinite-epoch pipeline; SEVERAL transfer threads keep device_put
+    # ahead of the compute stream.  Through the axon tunnel each put pays
+    # an RPC round trip, so a single prefetch thread serializes
+    # latency·batches; concurrent puts pipeline it (double buffering
+    # covers plain PCIe hosts too).
     reader = decoded_pipeline(shards, mode="train", image_size=224,
                               epochs=10_000, output="uint8")
     batches = batched_images(reader, batch)()
-    on_device: _q.Queue = _q.Queue(maxsize=2)
+    on_device: _q.Queue = _q.Queue(maxsize=4)
 
     prefetch_err = []
+    import threading
+
+    host_lock = threading.Lock()
 
     def prefetch():
         try:
-            for imgs, labels in batches:
-                on_device.put((jax.device_put(imgs), jax.device_put(labels.astype(np.int64))))
+            while True:
+                with host_lock:  # host-side decode/slice is not thread-safe
+                    imgs, labels = next(batches)
+                on_device.put((jax.device_put(imgs),
+                               jax.device_put(labels.astype(np.int32))))
+        except StopIteration:
+            pass
         except BaseException as e:  # noqa: BLE001
             prefetch_err.append(e)
             raise
 
-    import threading
-
-    t = threading.Thread(target=prefetch, daemon=True)
-    t.start()
+    threads = [threading.Thread(target=prefetch, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
 
     def next_feed():
-        # bounded wait + liveness check: a dead prefetch thread must turn
-        # into the error JSON line, never a silent driver timeout
+        # liveness check on EVERY call: with several transfer threads the
+        # survivors keep the queue full, so a partial death would silently
+        # shrink the measured concurrency if only checked on queue-empty
         while True:
+            if prefetch_err:
+                raise RuntimeError(
+                    "input prefetch thread died: %r" % (prefetch_err[0],))
             try:
                 x, y = on_device.get(timeout=30.0)
                 return {"data": x, "label": y}
             except _q.Empty:
-                if prefetch_err:
-                    raise RuntimeError(
-                        "input prefetch thread died: %r" % (prefetch_err[0],))
-                if not t.is_alive():
-                    raise RuntimeError("input prefetch thread exited early")
+                if not any(t.is_alive() for t in threads):
+                    raise RuntimeError("input prefetch threads exited early")
 
     for _ in range(3):  # warmup/compile
         fetches, state = jitted(state, next_feed())
@@ -454,10 +465,15 @@ def main():
         {},  # Transformer-base headline config (batch 64, seq 256)
         # long-context configs: flash attention's O(T) HBM advantage compounds;
         # no reference baseline exists for these shapes (vs_baseline omitted).
-        # At seq>=2048 the fused one-grid Pallas backward auto-engages
-        # (parallel/flash_attention.py FLASH_BWD_IMPL="auto").
+        # At seq=2048 the fused one-grid Pallas backward auto-engages (23%
+        # faster than the scan engine on-chip); at seq>=4096 its [T,
+        # block_k] f32 score intermediates blow the 16M/core scoped-VMEM
+        # limit so auto falls back to scan (parallel/flash_attention.py
+        # FLASH_BWD_IMPL="auto", round-5 sweep in PERF.md).
         {"batch": 16, "seq": 1024, "baseline": None,
          "metric": "transformer_seq1024_tokens_per_sec_per_chip", "iters": 15},
+        {"batch": 8, "seq": 2048, "baseline": None,
+         "metric": "transformer_seq2048_tokens_per_sec_per_chip", "iters": 12},
         {"batch": 4, "seq": 4096, "baseline": None,
          "metric": "transformer_seq4096_tokens_per_sec_per_chip", "iters": 10},
     ):

@@ -21,6 +21,8 @@ is the standard post-training recipe (~<1% top-1 loss on convnets).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ...registry import register
@@ -64,6 +66,52 @@ def _quantized_mul(ctx, op):
     ctx.set_output(op, "Out", out.reshape(tuple(xs[:xn]) + (wq.shape[1],)))
 
 
+# How the int8 conv reaches the MXU.  XLA maps int8×int8→int32
+# ``dot_general`` onto the MXU's double-rate int8 path, but an integer
+# ``conv_general_dilated`` may lower to a slow non-MXU path (the round-5
+# on-chip capture measured the direct integer conv at ~1% of the bf16
+# conv's throughput).  "matmul" decomposes the conv into kh·kw shifted
+# int8 matmuls (same MACs, each one MXU-shaped); "conv" is the direct
+# integer convolution; "auto" picks matmul on TPU, conv elsewhere.
+INT8_CONV_IMPL = os.environ.get("PADDLE_TPU_INT8_CONV_IMPL", "auto")
+
+
+def _int8_conv_as_matmuls(xq, wq, strides, pads, dil):
+    """Integer conv via kernel-position decomposition: for each of the
+    kh·kw filter taps, a strided slice of the (zero-padded) int8 input
+    contracts its channel dim against that tap's [O, I] int8 matrix on
+    the MXU (int32 accumulation); the kh·kw partial products sum in
+    int32.  Symmetric abs-max quantization makes zero padding exact.
+    Returns [N, O, OH, OW] int32."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    O, I, kh, kw = wq.shape
+    sh, sw = strides
+    ph, pw = pads
+    dh, dw = dil
+    xp = jnp.pad(xq, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    H, W = xp.shape[2], xp.shape[3]
+    OH = (H - ((kh - 1) * dh + 1)) // sh + 1
+    OW = (W - ((kw - 1) * dw + 1)) // sw + 1
+    acc = None
+    for di in range(kh):
+        for dj in range(kw):
+            xs = lax.slice(
+                xp,
+                (0, 0, di * dh, dj * dw),
+                (xp.shape[0], xp.shape[1],
+                 di * dh + (OH - 1) * sh + 1, dj * dw + (OW - 1) * sw + 1),
+                (1, 1, sh, sw))                      # [N, I, OH, OW] int8
+            # contract channels: [N, I, OH, OW] × [O, I] -> [N, OH, OW, O]
+            part = lax.dot_general(
+                xs, wq[:, :, di, dj],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            acc = part if acc is None else acc + part
+    return jnp.transpose(acc, (0, 3, 1, 2))
+
+
 @register("quantized_conv2d")
 def _quantized_conv2d(ctx, op):
     import jax
@@ -77,15 +125,22 @@ def _quantized_conv2d(ctx, op):
     dil = list(op.attrs.get("dilations", [1, 1]))
     groups = op.attrs.get("groups", 1) or 1
     xq, sx = _quantize_activation(x)
-    acc = jax.lax.conv_general_dilated(
-        xq, wq.astype(jnp.int8),
-        window_strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=groups,
-        preferred_element_type=jnp.int32,
-    )
+    impl = INT8_CONV_IMPL
+    if impl == "auto":
+        on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+        impl = "matmul" if (on_tpu and groups == 1) else "conv"
+    if impl == "matmul" and groups == 1:
+        acc = _int8_conv_as_matmuls(xq, wq.astype(jnp.int8), strides, pads, dil)
+    else:
+        acc = jax.lax.conv_general_dilated(
+            xq, wq.astype(jnp.int8),
+            window_strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dil,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups,
+            preferred_element_type=jnp.int32,
+        )
     out = acc.astype(jnp.float32) * (sx / _QMAX) * (ws.reshape(-1) / _QMAX)[None, :, None, None]
     out = out.astype(x.dtype) if x.dtype == jnp.bfloat16 else out
     ctx.set_output(op, "Output", out)

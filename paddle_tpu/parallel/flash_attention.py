@@ -349,33 +349,44 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:, :].astype(dq_ref.dtype)
 
 
-# Backward engine switch.  Measured on v5e (fwd+bwd, causal, H=8 D=64,
-# tokens held at 16k): scan 9.9/11.6/14.7/20.8 ms vs the two-kernel pallas
-# pair 11.1/13.2/18.1/27.6 ms at T=256/512/1024/2048 — XLA fuses the
-# scan's per-block einsums into a single-pass pipeline (p computed once
-# feeds dv/dq/dk), while the pair recomputes the score matmuls in each
-# pass (7 matmuls vs 5).  The third engine, "fused", is the
-# dq+dkv-in-ONE-grid kernel: full-T q/o/do/lse stay resident in VMEM, the
-# grid walks key blocks, each step emits that block's dk/dv AND
+# Backward engine switch.  Measured on v5e.  Round 3 (fwd+bwd, causal,
+# H=8 D=64, tokens held at 16k): scan 9.9/11.6/14.7/20.8 ms vs the
+# two-kernel pallas pair 11.1/13.2/18.1/27.6 ms at T=256/512/1024/2048 —
+# XLA fuses the scan's per-block einsums into a single-pass pipeline (p
+# computed once feeds dv/dq/dk), while the pair recomputes the score
+# matmuls in each pass (7 matmuls vs 5).  The third engine, "fused", is
+# the dq+dkv-in-ONE-grid kernel: full-T q/do/lse stay resident in VMEM,
+# the grid walks key blocks, each step emits that block's dk/dv AND
 # accumulates dq in a VMEM scratch — 5 matmuls and every tensor touches
-# HBM exactly once, but it needs the whole q-side in VMEM so it only
-# applies up to ~T=8k at D=64 (see _fused_bwd_vmem_bytes).  "auto" (the
-# default) picks: fused where it fits AND T >= _FUSED_MIN_T (short T is
-# latency-bound and scan's pipeline wins), scan elsewhere.
+# HBM exactly once.  Round 5 on-chip sweep (tools/bench_flash_bwd.py,
+# 16k tokens, B adjusted): T=2048 scan 22.0 / fused 16.95 / pair 27.6 ms
+# (fused wins by 23%); T=4096 the fused kernel FAILS to compile — scoped
+# VMEM 16.70M vs the 16.00M/core limit — so scan carries long T.
+# "auto" (the default) picks: fused where the calibrated VMEM model fits
+# AND T >= _FUSED_MIN_T (short T is latency-bound and scan wins), scan
+# elsewhere.
 FLASH_BWD_IMPL = "auto"
 _FUSED_MIN_T = 2048
-_FUSED_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom of the 16MB/core
+_FUSED_VMEM_BUDGET = 14 * 1024 * 1024  # 16MB/core scoped limit − margin
 
 
 def _fused_bwd_vmem_bytes(T, D, in_itemsize, block_k):
-    """Rough VMEM residency of the fused backward: q/o/do tiles (input
-    dtype), lse+delta lanes (f32), the f32 dq accumulator, and the
-    streamed k/v/dk/dv tiles (double-buffered)."""
-    qside = 3 * T * D * in_itemsize      # q, o, do
-    lanes = T * 128 * 4                  # lse+delta, lane-packed f32
-    acc = T * D * 4                      # dq scratch
-    kv = 4 * 2 * block_k * D * in_itemsize
-    return qside + lanes + acc + kv
+    """Scoped-VMEM residency of the fused backward, calibrated against the
+    compiler: at T=4096 D=64 bf16 bk=128 the TPU backend reports 16.70M
+    scoped (OOM vs the 16M limit), at T=2048 it compiles and runs.  The
+    dominant terms are the four [T, block_k] f32 intermediates the kernel
+    materializes (s, p, dp, ds) and the f32 casts of the resident q/do —
+    NOT the bf16 input tiles themselves.  Per-token bytes:
+      resident q+do (input dtype) .... 2·D·isz
+      f32 casts of q+do ............. 2·D·4
+      lse+delta lane-packed f32 ..... 128·4
+      dq f32 scratch ................ D·4
+      s/p/dp/ds intermediates ....... 4·block_k·4
+    plus the streamed, double-buffered k/v/dk/dv block tiles."""
+    per_token = (2 * D * in_itemsize + 2 * D * 4 + 128 * 4 + D * 4
+                 + 4 * block_k * 4)
+    kv = 4 * 2 * block_k * D * (in_itemsize + 4)
+    return T * per_token + kv
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):

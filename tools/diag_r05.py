@@ -1,0 +1,116 @@
+"""One-shot diagnostics for the two bench legs that collapsed in the
+round-5 capture (BENCH_live.json):
+
+1. int8 inference 102 img/s vs bf16 12.6k — is XLA's integer
+   `conv_general_dilated` off the MXU on TPU?  Times int8 vs bf16
+   dot_general and conv at ResNet-ish shapes.
+2. real-input 69 img/s (3% of synthetic) — is `jax.device_put` through
+   the axon tunnel latency- or bandwidth-bound?  Times uint8 batch
+   transfers at several sizes.
+
+Usage (healthy TPU, nothing else running): python tools/diag_r05.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("devices:", jax.devices(), flush=True)
+
+    # ---- 1. matmul: int8 vs bf16 --------------------------------------
+    M = N = K = 4096
+    rng = np.random.RandomState(0)
+    a8 = jnp.asarray(rng.randint(-127, 127, (M, K), dtype=np.int8))
+    b8 = jnp.asarray(rng.randint(-127, 127, (K, N), dtype=np.int8))
+    abf = a8.astype(jnp.bfloat16)
+    bbf = b8.astype(jnp.bfloat16)
+
+    def sync(x):
+        np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0]
+
+    dot8 = jax.jit(lambda x, y: jax.lax.dot_general(
+        x, y, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32))
+    dotb = jax.jit(lambda x, y: jax.lax.dot_general(
+        x, y, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32))
+
+    for name, f, x, y in (("dot_int8", dot8, a8, b8), ("dot_bf16", dotb, abf, bbf)):
+        out = f(x, y); sync(out)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = f(x, y)
+        sync(out)
+        dt = (time.perf_counter() - t0) / 20
+        print(f"{name}: {dt*1e3:.3f} ms  ({2*M*N*K/dt/1e12:.1f} TOP/s)", flush=True)
+
+    # ---- 2. conv: int8 vs bf16 (ResNet 3x3 mid-layer shape) -----------
+    x8 = jnp.asarray(rng.randint(-127, 127, (64, 256, 56, 56), dtype=np.int8))
+    w8 = jnp.asarray(rng.randint(-127, 127, (256, 256, 3, 3), dtype=np.int8))
+    xbf = x8.astype(jnp.bfloat16)
+    wbf = w8.astype(jnp.bfloat16)
+
+    def conv(pe):
+        def f(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=pe)
+        return jax.jit(f)
+
+    flops = 2 * 64 * 256 * 56 * 56 * 256 * 9
+    for name, f, x, w in (("conv_int8", conv(jnp.int32), x8, w8),
+                          ("conv_bf16", conv(jnp.float32), xbf, wbf)):
+        try:
+            out = f(x, w); sync(out)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = f(x, w)
+            sync(out)
+            dt = (time.perf_counter() - t0) / 10
+            print(f"{name}: {dt*1e3:.3f} ms  ({flops/dt/1e12:.1f} TOP/s)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {e}", flush=True)
+
+    # ---- 3. device_put: latency vs bandwidth through the tunnel -------
+    for mb in (0.1, 1.0, 19.3, 77.0):
+        nbytes = int(mb * 1e6)
+        host = np.zeros(nbytes, dtype=np.uint8)
+        # warm
+        d = jax.device_put(host); np.asarray(d[0])
+        t0 = time.perf_counter()
+        it = 3
+        for _ in range(it):
+            d = jax.device_put(host)
+            np.asarray(d[0])  # force completion through the tunnel
+        dt = (time.perf_counter() - t0) / it
+        print(f"device_put {mb:6.1f} MB: {dt*1e3:8.1f} ms  ({nbytes/dt/1e6:7.1f} MB/s)",
+              flush=True)
+
+    # concurrent double-buffering probe: do 2 transfers overlap?
+    import threading
+    host = np.zeros(int(19.3e6), dtype=np.uint8)
+    results = [None, None]
+
+    def put(i):
+        d = jax.device_put(host)
+        np.asarray(d[0])
+        results[i] = True
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=put, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    print(f"2 concurrent 19.3MB puts: {(time.perf_counter()-t0)*1e3:.1f} ms "
+          f"(serial would be 2x single)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
