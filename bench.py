@@ -234,13 +234,15 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
     on_device: _q.Queue = _q.Queue(maxsize=4)
 
     prefetch_err = []
+    stop = []  # non-empty = shut down (threads would otherwise keep ~7
+    #            batches pinned on device while later bench legs run)
     import threading
 
     host_lock = threading.Lock()
 
     def prefetch():
         try:
-            while True:
+            while not stop:
                 with host_lock:  # host-side decode/slice is not thread-safe
                     imgs, labels = next(batches)
                 on_device.put((jax.device_put(imgs),
@@ -279,6 +281,17 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
     np.asarray(fetches[0])
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
+
+    # release the transfer threads and their pinned device batches before
+    # the later (memory-hungry long-context) legs run
+    stop.append(True)
+    for t in threads:
+        while t.is_alive():
+            try:
+                on_device.get_nowait()
+            except _q.Empty:
+                pass
+            t.join(0.05)
 
     return {
         "metric": "resnet50_real_input_images_per_sec_per_chip",

@@ -129,6 +129,13 @@ def _quantized_conv2d(ctx, op):
     if impl == "auto":
         on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
         impl = "matmul" if (on_tpu and groups == 1) else "conv"
+    elif impl == "matmul" and groups > 1:
+        import warnings
+
+        warnings.warn(
+            "PADDLE_TPU_INT8_CONV_IMPL=matmul does not cover grouped "
+            "convolutions (groups=%d); this layer falls back to the direct "
+            "integer conv, which is far slower on TPU" % groups)
     if impl == "matmul" and groups == 1:
         acc = _int8_conv_as_matmuls(xq, wq.astype(jnp.int8), strides, pads, dil)
     else:

@@ -28,6 +28,7 @@ from . import core
 from .framework import (
     GRAD_SUFFIX,
     Block,
+    Parameter,
     Program,
     Variable,
     default_main_program,
@@ -651,6 +652,7 @@ class Executor:
         # ([(regex, PartitionSpec)]).
         self._mesh = None
         self._sharding_rules = None
+        self._zero_stage = 0
 
     # -- public API ----------------------------------------------------------
     def run(
@@ -971,27 +973,106 @@ class Executor:
                             if n in stacked or any(
                                     n.startswith(s + "_") for s in stacked):
                                 state_shardings[n] = pp_shard
+                # ZeRO (BuildStrategy.zero_stage): partition optimizer
+                # accumulators (stage>=1) and parameters (stage>=3) over
+                # 'dp' — each dp rank then holds 1/dp of the state and
+                # computes 1/dp of the update; XLA's partitioner inserts
+                # the use-site all-gathers and turns the gradient
+                # psum+slice into a reduce-scatter.  Stage 2 (gradient
+                # partitioning) has no separate lever here: gradients are
+                # not persistent state under jit, their sharding follows
+                # the update site.
+                zero = int(getattr(self, "_zero_stage", 0) or 0)
+                if zero >= 1 and "dp" in mesh.axis_names and dp_size > 1:
+                    tagged = {
+                        v.name for v in program.list_vars()
+                        if getattr(v, "is_optimizer_state", False)
+                    }
+                    if zero >= 3:
+                        tagged |= {
+                            v.name for v in program.list_vars()
+                            if isinstance(v, Parameter)
+                        }
+
+                    def with_dp(n, v):
+                        # largest dim divisible by dp that the current spec
+                        # leaves free; None when nothing divides (tiny /
+                        # scalar state stays replicated)
+                        cur = tuple(state_shardings.get(n, repl).spec)
+                        shape = np.shape(v)
+                        cur = cur + (None,) * (len(shape) - len(cur))
+                        for i in sorted(range(len(shape)),
+                                        key=lambda i: -shape[i]):
+                            if (shape[i] >= dp_size
+                                    and shape[i] % dp_size == 0
+                                    and cur[i] is None):
+                                spec = list(cur)
+                                spec[i] = "dp"
+                                return NamedSharding(mesh, P(*spec))
+                        return None
+
+                    for n, v in state.items():
+                        if n in tagged:
+                            s = with_dp(n, v)
+                            if s is not None:
+                                state_shardings[n] = s
+                # pin state OUT-shardings too: the partitioner would
+                # otherwise hand state out however propagation landed (a
+                # ZeRO-updated param emerges dp-sharded) and the reshard
+                # back to the declared sharding would run as a host-issued
+                # device_put after every step; pinned, it folds into the
+                # compiled step.  new_state's keys normally equal state's;
+                # a program whose step CREATES a persistable (keys differ
+                # -> pytree structure error on first call) falls back to
+                # unpinned outputs + the explicit conform below.
+                cell["in_sh"] = (state_shardings, feed_shardings, repl)
                 jitted = jax.jit(
                     step,
-                    in_shardings=(state_shardings, feed_shardings, repl),
+                    in_shardings=cell["in_sh"],
+                    out_shardings=(None, dict(state_shardings), None),
                     donate_argnums=(0,),
                 )
                 cell["jit"] = jitted
+                cell["out_pinned"] = True
                 cell["state_shardings"] = state_shardings
             # XLA's partitioner may hand state OUT in different shardings
             # than the declared in_shardings (e.g. a bias left tp-sharded
-            # after propagation); jit refuses committed args that disagree,
-            # so reshard drifted entries explicitly (no-op when they match).
+            # after propagation, or a ZeRO-updated param emerging
+            # dp-sharded); jit refuses committed args that disagree, so
+            # reshard drifted entries explicitly (no-op when they match).
+            # Incoming state is normalized too for externally loaded
+            # arrays (checkpoint restore, host numpy).
             state_shardings = cell["state_shardings"]
-            state = {
-                n: v
-                if getattr(v, "sharding", None) == state_shardings.get(n)
-                else jax.device_put(v, state_shardings[n])
-                for n, v in state.items()
-            }
+
+            def conform(d):
+                return {
+                    n: v
+                    if n not in state_shardings
+                    or getattr(v, "sharding", None) == state_shardings[n]
+                    else jax.device_put(v, state_shardings[n])
+                    for n, v in d.items()
+                }
+
+            state = conform(state)
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
-                return jitted(state, feeds, key)
+                try:
+                    fetches, new_state, next_key = cell["jit"](state, feeds, key)
+                except (TypeError, ValueError):
+                    if not cell.get("out_pinned"):
+                        raise
+                    # new_state's structure differs from state's (step
+                    # creates a persistable): re-jit without pinned
+                    # outputs; a genuine user error re-raises identically
+                    cell["jit"] = jax.jit(
+                        step, in_shardings=cell["in_sh"], donate_argnums=(0,))
+                    cell["out_pinned"] = False
+                    fetches, new_state, next_key = cell["jit"](state, feeds, key)
+            if cell.get("out_pinned"):
+                return fetches, new_state, next_key
+            # unpinned fallback: keep the AT-REST contract explicitly —
+            # scope state between runs conforms to the declared shardings
+            return fetches, conform(new_state), next_key
 
         return runner
 

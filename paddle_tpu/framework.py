@@ -219,6 +219,10 @@ class Variable:
         # serialization (the executor's pp sharding keys off this flag)
         if getattr(self, "pp_stacked", False):
             d["pp_stacked"] = True
+        # optimizer accumulators carry their tag through serialization (the
+        # executor's ZeRO dp-sharding keys off this flag)
+        if getattr(self, "is_optimizer_state", False):
+            d["is_optimizer_state"] = True
         return d
 
 
@@ -571,6 +575,8 @@ class Program:
                     v.capacity = int(vd["capacity"])
                 if vd.get("pp_stacked"):
                     v.pp_stacked = True
+                if vd.get("is_optimizer_state"):
+                    v.is_optimizer_state = True
                 b.vars[v.name] = v
             for od in bd["ops"]:
                 attrs = {}

@@ -112,6 +112,9 @@ class Optimizer:
             shape=shape if shape is not None else param.shape,
         )
         var.stop_gradient = True
+        # the ZeRO sharding pass (executor; BuildStrategy.zero_stage)
+        # partitions exactly the vars carrying this tag over 'dp'
+        var.is_optimizer_state = True
         helper.set_variable_initializer(var, Constant(value=float(fill_value)))
         self._accumulators[name][param.name] = var
         return var

@@ -57,9 +57,14 @@ class BuildStrategy:
         # TPU extensions (no reference analog — the reference is dp-only):
         # mesh_shape: (dp, tp[, sp]) tuple or {"dp": .., "tp": .., "sp": ..};
         # sharding_rules: [(param_name_regex, PartitionSpec)] overrides for
-        # parallel.tp.make_param_shardings.
+        # parallel.tp.make_param_shardings;
+        # zero_stage: 0 (off), 1 (optimizer accumulators dp-sharded), or 3
+        # (parameters too) — ZeRO via sharding annotations; XLA's SPMD
+        # partitioner inserts the just-in-time all-gathers and turns the
+        # gradient psum+slice into reduce-scatter at the sharded update.
         self.mesh_shape = None
         self.sharding_rules = None
+        self.zero_stage = 0
 
 
 def build_mesh(mesh_shape=None, devices=None):
@@ -103,6 +108,7 @@ class ParallelExecutor:
         devices=None,
         mesh_shape=None,
         sharding_rules=None,
+        zero_stage=None,
     ):
         from .core import safe_import_jax
 
@@ -116,10 +122,13 @@ class ParallelExecutor:
             mesh_shape = getattr(build_strategy, "mesh_shape", None)
         if sharding_rules is None and build_strategy is not None:
             sharding_rules = getattr(build_strategy, "sharding_rules", None)
+        if zero_stage is None and build_strategy is not None:
+            zero_stage = getattr(build_strategy, "zero_stage", 0)
         self._mesh = build_mesh(mesh_shape, devs)
         self._exe = Executor()
         self._exe._mesh = self._mesh
         self._exe._sharding_rules = sharding_rules
+        self._exe._zero_stage = int(zero_stage or 0)
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 

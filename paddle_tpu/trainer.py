@@ -115,14 +115,17 @@ class Trainer:
     Optimizer.  Runs the loop, fires events, checkpoints, resumes."""
 
     def __init__(self, train_func, optimizer_func, param_path=None, place=None,
-                 parallel=False, checkpoint_config=None, sharding_rules=None):
+                 parallel=False, checkpoint_config=None, sharding_rules=None,
+                 zero_stage=0):
         """``parallel``: False = single device; True = data-parallel over
         every device (the reference's ParallelExecutor-under-Trainer mode);
         a ``(dp, tp[, sp])`` tuple or ``{axis: size}`` dict = multi-axis
         mesh with Megatron tp shardings (parallel_executor.build_mesh),
         refined by ``sharding_rules``.  A ``pp`` axis runs layers.Pipeline
         stages GPipe-style (one stage per device); an ``ep`` axis runs
-        layers.switch_moe experts with all-to-all dispatch."""
+        layers.switch_moe experts with all-to-all dispatch; ``zero_stage``
+        (1 or 3) ZeRO-shards optimizer state (and, at 3, parameters) over
+        the ``dp`` axis."""
         from .core import TPUPlace
 
         self.place = place if place is not None else TPUPlace()
@@ -151,6 +154,7 @@ class Trainer:
 
             self.exe._mesh = build_mesh(parallel)
             self.exe._sharding_rules = sharding_rules
+            self.exe._zero_stage = int(zero_stage or 0)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
             if param_path:

@@ -8,62 +8,8 @@
 # atomic and auto-releases when the holder dies, so there are no stale-flag
 # or check-then-touch races.
 LOG=${1:-/root/repo/probe_r05.log}
-LOCK=/tmp/tpu_pytest.lock
 cd /root/repo
-
-probe() {
-  timeout 200 python - >> "$LOG" 2>&1 <<'EOF'
-import threading, time, sys
-res = {}
-def probe():
-    try:
-        import jax
-        res['n'] = len(jax.devices())
-    except Exception as e:
-        res['err'] = repr(e)
-t = threading.Thread(target=probe, daemon=True)
-t0 = time.time()
-t.start(); t.join(180)
-if 'n' in res:
-    print('HEALTHY: %d device(s) in %.1fs' % (res['n'], time.time()-t0)); sys.exit(0)
-print('WEDGED/ERR after %.1fs: %s' % (time.time()-t0, res.get('err','hang'))); sys.exit(1)
-EOF
-}
-
-# bench.py always prints one JSON line (per-metric failures become "error"
-# fields); only a TOP-LEVEL error — headline metric dead, tunnel wedged —
-# should count as a failed leg.  Partial results with some erroring extra
-# metrics are still worth keeping.
-top_level_error() {
-  python - "$1" <<'EOF'
-import json, sys
-try:
-    d = json.load(open(sys.argv[1]))
-except Exception:
-    sys.exit(0)  # not JSON (flash/flags legs): rc alone decides
-sys.exit(1 if isinstance(d, dict) and "error" in d else 0)
-EOF
-  [ $? -eq 1 ]
-}
-
-# run_leg <output-file> <timeout> <cmd...>: skip if a good output already
-# exists; write to .tmp and promote only on success (rc 0 and no top-level
-# "error"), so a re-wedged tunnel can't truncate an earlier good result.
-run_leg() {
-  local out=$1 tmo=$2; shift 2
-  if [ -s "$out" ] && ! top_level_error "$out"; then
-    echo "$(date -u +%H:%M:%S) skip $out (already captured)" >> "$LOG"
-    return 0
-  fi
-  timeout "$tmo" "$@" > "$out.tmp" 2>> "$LOG"
-  local rc=$?
-  echo "$(date -u +%H:%M:%S) $* done rc=$rc" >> "$LOG"
-  if [ $rc -eq 0 ] && [ -s "$out.tmp" ] && ! top_level_error "$out.tmp"; then
-    mv "$out.tmp" "$out"
-    return 0
-  fi
-  return 1
-}
+. tools/watchdog_lib.sh
 
 while true; do
   (
@@ -76,7 +22,9 @@ while true; do
     all_ok=1
     run_leg /root/repo/BENCH_live.json       3600 python bench.py || all_ok=0
     run_leg /root/repo/FLASH_BWD_live.txt    2400 python tools/bench_flash_bwd.py || all_ok=0
-    run_leg /root/repo/RESNET_FLAGS_live.txt 3600 python tools/bench_resnet_flags.py || all_ok=0
+    # (compiler-flag sweep removed: non-default compiler_options hang the
+    # axon remote compile and the timeout SIGTERM wedges the tunnel — see
+    # PERF.md round 5)
     run_leg /root/repo/INFERENCE_HLO_SUMMARY.txt 1800 python tools/dump_inference_hlo.py --out /root/repo/INFERENCE_HLO.txt || all_ok=0
     [ $all_ok -eq 1 ] || exit 1
     echo "$(date -u +%H:%M:%S) BENCH SEQUENCE COMPLETE" >> "$LOG"

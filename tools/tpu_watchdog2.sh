@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 session-3 recovery sequence.  Differences from tpu_watchdog.sh:
+#  - runs tools/diag_r05.py first (int8 / device_put attribution);
+#  - re-captures bench.py FRESH (the prior BENCH_live.json predates the
+#    flash-threshold + int8 + prefetch fixes; it is preserved as
+#    BENCH_live_r05a.json);
+#  - does NOT run tools/bench_resnet_flags.py: non-default
+#    compiler_options hang the axon remote compile (see PERF.md round 5)
+#    and the timeout SIGTERM is what wedged the tunnel.
+LOG=${1:-/root/repo/probe_r05.log}
+cd /root/repo
+. tools/watchdog_lib.sh
+
+[ -s BENCH_live.json ] && [ ! -s BENCH_live_r05a.json ] && mv BENCH_live.json BENCH_live_r05a.json
+
+while true; do
+  (
+    flock -n 9 || { echo "$(date -u +%H:%M:%S) skip probe: pytest holds lock" >> "$LOG"; exit 2; }
+    echo "$(date -u +%H:%M:%S) [wd2] probing backend init..." >> "$LOG"
+    probe || exit 1
+    echo "$(date -u +%H:%M:%S) [wd2] tunnel healthy — diag + fresh bench" >> "$LOG"
+    all_ok=1
+    run_leg /root/repo/DIAG_r05.txt          900 python tools/diag_r05.py || all_ok=0
+    run_leg /root/repo/BENCH_live.json      3600 python bench.py || all_ok=0
+    run_leg /root/repo/INFERENCE_HLO_SUMMARY.txt 1800 python tools/dump_inference_hlo.py --out /root/repo/INFERENCE_HLO.txt || all_ok=0
+    [ $all_ok -eq 1 ] || exit 1
+    echo "$(date -u +%H:%M:%S) [wd2] SEQUENCE COMPLETE" >> "$LOG"
+    exit 0
+  ) 9>"$LOCK"
+  case $? in
+    0) exit 0 ;;
+    2) sleep 120 ;;
+    *) sleep 600 ;;
+  esac
+done

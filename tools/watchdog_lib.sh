@@ -1,0 +1,62 @@
+# Shared helpers for the TPU capture watchdogs (tpu_watchdog.sh /
+# tpu_watchdog2.sh).  Sourced, not executed.  Expects $LOG to be set.
+#
+# Mutual exclusion with pytest: both watchdogs and tools/run_tests.sh
+# take an exclusive flock on /tmp/tpu_pytest.lock around their work.
+# flock is atomic and auto-releases when the holder dies, so there are
+# no stale-flag or check-then-touch races.
+LOCK=/tmp/tpu_pytest.lock
+
+probe() {
+  timeout 200 python - >> "$LOG" 2>&1 <<'EOF'
+import threading, time, sys
+res = {}
+def probe():
+    try:
+        import jax
+        res['n'] = len(jax.devices())
+    except Exception as e:
+        res['err'] = repr(e)
+t = threading.Thread(target=probe, daemon=True)
+t0 = time.time()
+t.start(); t.join(180)
+if 'n' in res:
+    print('HEALTHY: %d device(s) in %.1fs' % (res['n'], time.time()-t0)); sys.exit(0)
+print('WEDGED/ERR after %.1fs: %s' % (time.time()-t0, res.get('err','hang'))); sys.exit(1)
+EOF
+}
+
+# bench.py always prints one JSON line (per-metric failures become "error"
+# fields); only a TOP-LEVEL error — headline metric dead, tunnel wedged —
+# should count as a failed leg.  Partial results with some erroring extra
+# metrics are still worth keeping.
+top_level_error() {
+  python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(0)  # not JSON (text legs): rc alone decides
+sys.exit(1 if isinstance(d, dict) and "error" in d else 0)
+EOF
+  [ $? -eq 1 ]
+}
+
+# run_leg <output-file> <timeout> <cmd...>: skip if a good output already
+# exists; write to .tmp and promote only on success (rc 0 and no top-level
+# "error"), so a re-wedged tunnel can't truncate an earlier good result.
+run_leg() {
+  local out=$1 tmo=$2; shift 2
+  if [ -s "$out" ] && ! top_level_error "$out"; then
+    echo "$(date -u +%H:%M:%S) skip $out (already captured)" >> "$LOG"
+    return 0
+  fi
+  timeout "$tmo" "$@" > "$out.tmp" 2>> "$LOG"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) $* done rc=$rc" >> "$LOG"
+  if [ $rc -eq 0 ] && [ -s "$out.tmp" ] && ! top_level_error "$out.tmp"; then
+    mv "$out.tmp" "$out"
+    return 0
+  fi
+  return 1
+}
