@@ -272,26 +272,31 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
                 if not any(t.is_alive() for t in threads):
                     raise RuntimeError("input prefetch threads exited early")
 
-    for _ in range(3):  # warmup/compile
-        fetches, state = jitted(state, next_feed())
-    np.asarray(fetches[0])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        fetches, state = jitted(state, next_feed())
-    np.asarray(fetches[0])
-    dt = time.perf_counter() - t0
-    ips = batch * iters / dt
-
-    # release the transfer threads and their pinned device batches before
-    # the later (memory-hungry long-context) legs run
-    stop.append(True)
-    for t in threads:
-        while t.is_alive():
-            try:
-                on_device.get_nowait()
-            except _q.Empty:
-                pass
-            t.join(0.05)
+    try:
+        for _ in range(3):  # warmup/compile
+            fetches, state = jitted(state, next_feed())
+        np.asarray(fetches[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fetches, state = jitted(state, next_feed())
+        np.asarray(fetches[0])
+        dt = time.perf_counter() - t0
+        ips = batch * iters / dt
+    finally:
+        # release the transfer threads and their pinned device batches
+        # before the later (memory-hungry long-context) legs run — on the
+        # error path too.  Deadline-capped: a thread wedged inside a
+        # device_put RPC must not hang a leg whose measurement is done
+        # (daemon threads die with the process anyway).
+        stop.append(True)
+        deadline = time.monotonic() + 5.0
+        for t in threads:
+            while t.is_alive() and time.monotonic() < deadline:
+                try:
+                    on_device.get_nowait()
+                except _q.Empty:
+                    pass
+                t.join(0.05)
 
     return {
         "metric": "resnet50_real_input_images_per_sec_per_chip",

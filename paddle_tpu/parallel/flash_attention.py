@@ -366,6 +366,12 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 # AND T >= _FUSED_MIN_T (short T is latency-bound and scan wins), scan
 # elsewhere.
 FLASH_BWD_IMPL = "auto"
+# Backward-only key-block override (None = use the forward's block_k).
+# Shrinking ONLY the backward's block halves its [T, block_k] f32
+# intermediates without touching the forward kernel — the knob that could
+# let the fused engine fit scoped VMEM at T=4096 (tools/bench_flash_bwd.py
+# measures whether the half-width lanes pay for themselves).
+FLASH_BWD_BLOCK_K = None
 _FUSED_MIN_T = 2048
 _FUSED_VMEM_BUDGET = 14 * 1024 * 1024  # 16MB/core scoped limit − margin
 
@@ -390,6 +396,8 @@ def _fused_bwd_vmem_bytes(T, D, in_itemsize, block_k):
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    if FLASH_BWD_BLOCK_K:
+        block_k = int(FLASH_BWD_BLOCK_K)
     impl = FLASH_BWD_IMPL
     if impl == "auto":
         q = res[0]

@@ -37,8 +37,14 @@ def main():
         v = jax.random.normal(ks[2], (B, H, T, D), dt)
 
         times = {}
-        for impl in ("scan", "fused", "pallas"):
-            FA.FLASH_BWD_IMPL = impl
+        # fused64: the fused one-grid backward at BACKWARD-ONLY block_k=64
+        # (FLASH_BWD_BLOCK_K; the forward keeps bk=128) — the [T, bk] f32
+        # intermediates halve, fitting scoped VMEM up to T=4096 where
+        # bk=128 OOMs (PERF.md round-5 calibration); half-width lanes may
+        # cost MXU efficiency, hence measured rather than assumed
+        for impl in ("scan", "fused", "pallas", "fused64"):
+            FA.FLASH_BWD_IMPL = "fused" if impl == "fused64" else impl
+            FA.FLASH_BWD_BLOCK_K = 64 if impl == "fused64" else None
 
             def loss(q, k, v):
                 o = FA.flash_attention(q, k, v, None, True, None, 128, 128,
@@ -61,13 +67,14 @@ def main():
         rows.append((T, B, times))
         print("T=%d B=%d: %s" % (T, B, {k_: round(v_, 2) for k_, v_ in times.items()}))
 
-    print("\n| T | B | scan ms | fused ms | pair ms | winner |")
-    print("|---|---|---|---|---|---|")
+    print("\n| T | B | scan ms | fused ms | fused-bk64 ms | pair ms | winner |")
+    print("|---|---|---|---|---|---|---|")
     for T, B, t in rows:
         best = min((v, k_) for k_, v in t.items() if v == v)[1]
-        print("| %d | %d | %.2f | %.2f | %.2f | %s |"
+        print("| %d | %d | %.2f | %.2f | %.2f | %.2f | %s |"
               % (T, B, t.get("scan", float("nan")), t.get("fused", float("nan")),
-                 t.get("pallas", float("nan")), best))
+                 t.get("fused64", float("nan")), t.get("pallas", float("nan")),
+                 best))
 
 
 if __name__ == "__main__":

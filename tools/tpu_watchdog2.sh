@@ -22,6 +22,7 @@ while true; do
     all_ok=1
     run_leg /root/repo/DIAG_r05.txt          900 python tools/diag_r05.py || all_ok=0
     run_leg /root/repo/BENCH_live.json      3600 python bench.py || all_ok=0
+    run_leg /root/repo/FLASH_BWD64_live.txt 2400 python tools/bench_flash_bwd.py || all_ok=0
     run_leg /root/repo/INFERENCE_HLO_SUMMARY.txt 1800 python tools/dump_inference_hlo.py --out /root/repo/INFERENCE_HLO.txt || all_ok=0
     [ $all_ok -eq 1 ] || exit 1
     echo "$(date -u +%H:%M:%S) [wd2] SEQUENCE COMPLETE" >> "$LOG"
