@@ -654,6 +654,19 @@ class Executor:
         self._sharding_rules = None
         self._zero_stage = 0
 
+    def attach_mesh(self, mesh_spec, sharding_rules=None, zero_stage=0,
+                    devices=None):
+        """Attach a device mesh (True = 1-D dp mesh over every device, or
+        a (dp, tp[, sp]) tuple / {axis: size} dict — parallel_executor.
+        build_mesh) so runs execute SPMD; the single entry point used by
+        ParallelExecutor, Trainer, and Inferencer."""
+        from .parallel_executor import build_mesh
+
+        self._mesh = build_mesh(mesh_spec, devices)
+        self._sharding_rules = sharding_rules
+        self._zero_stage = int(zero_stage or 0)
+        return self._mesh
+
     # -- public API ----------------------------------------------------------
     def run(
         self,

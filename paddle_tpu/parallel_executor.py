@@ -124,11 +124,10 @@ class ParallelExecutor:
             sharding_rules = getattr(build_strategy, "sharding_rules", None)
         if zero_stage is None and build_strategy is not None:
             zero_stage = getattr(build_strategy, "zero_stage", 0)
-        self._mesh = build_mesh(mesh_shape, devs)
         self._exe = Executor()
-        self._exe._mesh = self._mesh
-        self._exe._sharding_rules = sharding_rules
-        self._exe._zero_stage = int(zero_stage or 0)
+        self._mesh = self._exe.attach_mesh(
+            mesh_shape, sharding_rules=sharding_rules,
+            zero_stage=zero_stage, devices=devs)
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 

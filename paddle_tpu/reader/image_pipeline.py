@@ -226,15 +226,27 @@ def decoded_pipeline(files, mode="train", image_size=224, num_workers=2,
                      output="uint8"):
     """Reader over PRE-DECODED uint8 shards: augmentation is a random (or
     center) crop + flip by array slicing — no codec work at train time.
-    Yields (CHW uint8 [or normalized float32], int64 label)."""
+    Yields (CHW uint8 [or normalized float32], int64 label).
+
+    Determinism: the augmentation RNG is keyed by (seed, record content,
+    occurrence index), so a given image gets the same crop/flip for a
+    given seed regardless of the order the loader's worker threads
+    deliver records in, while its k-th appearance (epoch k, or an
+    in-dataset duplicate) draws a FRESH augmentation; the stream ORDER
+    itself may vary run-to-run (threads race into the shuffle buffer)."""
+    import zlib
 
     def reader():
         src = _record_source(files, max(2, num_workers), queue_capacity,
                              shuffle_buf if mode == "train" else 0, seed, epochs)
-        for i, rec in enumerate(src):
+        seen = {}
+        for rec in src:
             label, h, w = struct.unpack_from("<IHH", rec, 0)
             arr = np.frombuffer(rec, np.uint8, h * w * 3, 8).reshape(h, w, 3)
-            gen = np.random.default_rng([seed, i])
+            crc = zlib.crc32(rec)
+            occ = seen.get(crc, 0)
+            seen[crc] = occ + 1
+            gen = np.random.default_rng([seed, crc, occ])
             s = image_size
             if h < s or w < s:
                 raise ValueError(

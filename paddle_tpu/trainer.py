@@ -150,11 +150,8 @@ class Trainer:
         self.test_program = self.train_program.clone(for_test=True)
         self.exe = Executor(self.place)
         if parallel:
-            from .parallel_executor import build_mesh
-
-            self.exe._mesh = build_mesh(parallel)
-            self.exe._sharding_rules = sharding_rules
-            self.exe._zero_stage = int(zero_stage or 0)
+            self.exe.attach_mesh(parallel, sharding_rules=sharding_rules,
+                                 zero_stage=zero_stage)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
             if param_path:
@@ -266,6 +263,10 @@ class Inferencer:
                 outs = infer_func()
                 self.predict_vars = list(outs) if isinstance(outs, (list, tuple)) else [outs]
         self.exe = Executor(self.place)
+        if parallel:
+            # batch-sharded inference over the device mesh (True = 1-D dp
+            # mesh over every device, or a Trainer-style mesh spec)
+            self.exe.attach_mesh(parallel)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
             io_mod.load_persistables(self.exe, param_path, main_program=self.inference_program)

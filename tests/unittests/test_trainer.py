@@ -17,6 +17,11 @@ def _train_func():
     return loss
 
 
+def _infer_func():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    return fluid.layers.fc(input=x, size=1, param_attr=fluid.ParamAttr(name="w"))
+
+
 def _optimizer_func():
     return fluid.optimizer.SGD(learning_rate=0.05)
 
@@ -69,11 +74,7 @@ def test_inferencer_roundtrip(tmp_path):
     t.train(num_epochs=2, reader=_reader, feed_order=["x", "y"])
     t.save_params(str(tmp_path / "p"))
 
-    def infer_func():
-        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
-        return fluid.layers.fc(input=x, size=1, param_attr=fluid.ParamAttr(name="w"))
-
-    inf = fluid.Inferencer(infer_func, str(tmp_path / "p"), place=fluid.CPUPlace())
+    inf = fluid.Inferencer(_infer_func, str(tmp_path / "p"), place=fluid.CPUPlace())
     xs = np.ones((3, 4), "float32")
     (out,) = inf.infer({"x": xs})
     assert out.shape == (3, 1) and np.isfinite(out).all()
@@ -146,3 +147,22 @@ def test_trainer_parallel_mesh_matches_single_device():
     mesh_losses, w_mesh = run(parallel=(4, 2))
     np.testing.assert_allclose(mesh_losses, single_losses, rtol=1e-4)
     np.testing.assert_allclose(w_mesh, w_single, rtol=1e-4, atol=1e-6)
+
+
+def test_inferencer_parallel_matches_single_device(tmp_path):
+    """Inferencer(parallel=True) batch-shards inference over the mesh and
+    must reproduce single-device predictions exactly."""
+    t = fluid.Trainer(_train_func, _optimizer_func, place=fluid.CPUPlace())
+    t.train(num_epochs=1, reader=_reader, feed_order=["x", "y"])
+    t.save_params(str(tmp_path))
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 4).astype("float32")  # 16 % 8 == 0: dp-shardable
+
+    inf1 = fluid.Inferencer(_infer_func, str(tmp_path), place=fluid.CPUPlace())
+    (want,) = inf1.infer({"x": X})
+    infp = fluid.Inferencer(_infer_func, str(tmp_path), place=fluid.CPUPlace(),
+                            parallel=True)
+    (got,) = infp.infer({"x": X})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
