@@ -72,8 +72,21 @@ def _quantized_mul(ctx, op):
 # on-chip capture measured the direct integer conv at ~1% of the bf16
 # conv's throughput).  "matmul" decomposes the conv into kh·kw shifted
 # int8 matmuls (same MACs, each one MXU-shaped); "conv" is the direct
-# integer convolution; "auto" picks matmul on TPU, conv elsewhere.
+# integer convolution; "dequant" skips activation quantization and runs
+# a bf16 conv with dequantized weights (bf16 MAC rate, int8 STORAGE
+# kept); "auto" picks per layer on TPU: matmul where the channel
+# contraction is MXU-worthy, dequant for thin-channel convs (e.g. the
+# RGB stem, whose per-tap K=3 matmuls would waste the 128-lane MXU),
+# and conv elsewhere/CPU.
 INT8_CONV_IMPL = os.environ.get("PADDLE_TPU_INT8_CONV_IMPL", "auto")
+_MATMUL_MIN_CIN = 16  # below this, per-tap K is too thin for the MXU
+
+
+def _pick_conv_impl(on_tpu, groups, c_in):
+    """Auto-mode per-layer engine choice (pure, unit-tested)."""
+    if not on_tpu or groups != 1:
+        return "conv"
+    return "matmul" if c_in >= _MATMUL_MIN_CIN else "dequant"
 
 
 def _int8_conv_as_matmuls(xq, wq, strides, pads, dil):
@@ -124,11 +137,10 @@ def _quantized_conv2d(ctx, op):
     pads = list(op.attrs.get("paddings", [0, 0]))
     dil = list(op.attrs.get("dilations", [1, 1]))
     groups = op.attrs.get("groups", 1) or 1
-    xq, sx = _quantize_activation(x)
     impl = INT8_CONV_IMPL
     if impl == "auto":
         on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
-        impl = "matmul" if (on_tpu and groups == 1) else "conv"
+        impl = _pick_conv_impl(on_tpu, groups, int(wq.shape[1]))
     elif impl == "matmul" and groups > 1:
         import warnings
 
@@ -136,18 +148,32 @@ def _quantized_conv2d(ctx, op):
             "PADDLE_TPU_INT8_CONV_IMPL=matmul does not cover grouped "
             "convolutions (groups=%d); this layer falls back to the direct "
             "integer conv, which is far slower on TPU" % groups)
+    conv_kwargs = dict(
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    if impl == "dequant":
+        # bf16 conv with dequantized weights: int8 storage preserved, MACs
+        # at the bf16 rate — the right trade for thin-channel layers
+        cdt = x.dtype if x.dtype == jnp.bfloat16 else jnp.float32
+        wdq = (wq.astype(jnp.float32)
+               * (ws.reshape(-1) / _QMAX)[:, None, None, None]).astype(cdt)
+        out = jax.lax.conv_general_dilated(
+            x.astype(cdt), wdq,
+            preferred_element_type=jnp.float32, **conv_kwargs)
+        out = out.astype(x.dtype) if x.dtype == jnp.bfloat16 else out
+        ctx.set_output(op, "Output", out)
+        return
+    xq, sx = _quantize_activation(x)
     if impl == "matmul" and groups == 1:
         acc = _int8_conv_as_matmuls(xq, wq.astype(jnp.int8), strides, pads, dil)
     else:
         acc = jax.lax.conv_general_dilated(
             xq, wq.astype(jnp.int8),
-            window_strides=strides,
-            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-            rhs_dilation=dil,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=groups,
-            preferred_element_type=jnp.int32,
-        )
+            preferred_element_type=jnp.int32, **conv_kwargs)
     out = acc.astype(jnp.float32) * (sx / _QMAX) * (ws.reshape(-1) / _QMAX)[None, :, None, None]
     out = out.astype(x.dtype) if x.dtype == jnp.bfloat16 else out
     ctx.set_output(op, "Output", out)

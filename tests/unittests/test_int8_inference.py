@@ -192,3 +192,44 @@ def test_int8_inference_matches_float_matmul_impl():
         m.INT8_CONV_IMPL = old
     assert np.abs(got - ref).max() < 0.03, np.abs(got - ref).max()
     np.testing.assert_array_equal(got.argmax(1), ref.argmax(1))
+
+
+def test_int8_conv_dequant_impl_close_to_float():
+    """The thin-channel 'dequant' path (bf16/f32 conv over dequantized
+    int8 weights) stays within weight-quantization error of the float
+    program — tighter than the fully quantized path since activations
+    are never quantized."""
+    from paddle_tpu.contrib.quantize import int8_inference as m
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 3, 16, 16).astype("float32")
+
+    with fluid.unique_name.guard():
+        main, startup, out = _build_net()
+    infer = main.clone(for_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    old = m.INT8_CONV_IMPL
+    m.INT8_CONV_IMPL = "dequant"
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            (ref,) = exe.run(infer, feed={"img": x}, fetch_list=[out])
+            Int8InferenceTranspiler().transpile(infer, fluid.global_scope())
+            (got,) = exe.run(infer, feed={"img": x}, fetch_list=[out])
+    finally:
+        m.INT8_CONV_IMPL = old
+    assert np.abs(got - ref).max() < 0.03, np.abs(got - ref).max()
+    np.testing.assert_array_equal(got.argmax(1), ref.argmax(1))
+
+
+def test_int8_conv_auto_dispatch():
+    """Auto mode picks per layer: MXU int8 matmuls for wide channels,
+    dequantized bf16 conv for thin ones, direct conv off-TPU/grouped."""
+    from paddle_tpu.contrib.quantize.int8_inference import _pick_conv_impl
+
+    assert _pick_conv_impl(True, 1, 256) == "matmul"
+    assert _pick_conv_impl(True, 1, 16) == "matmul"
+    assert _pick_conv_impl(True, 1, 3) == "dequant"   # RGB stem
+    assert _pick_conv_impl(True, 2, 256) == "conv"    # grouped
+    assert _pick_conv_impl(False, 1, 256) == "conv"   # CPU

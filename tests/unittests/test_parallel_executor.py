@@ -287,3 +287,39 @@ def test_parallel_executor_pure_tp_mesh_without_dp_axis():
             for _ in range(3)
         ]
     np.testing.assert_allclose(got, single, rtol=1e-5)
+
+
+def test_mesh_runner_out_pinning_fallback_on_step_created_persistable():
+    """The executor pins state out_shardings (reshard compiles into the
+    step); a program whose step CREATES a persistable var the startup
+    never initialized changes new_state's pytree structure, which must
+    fall back to unpinned outputs + explicit conform — transparently."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        s = fluid.layers.reduce_sum(h)
+        # persistable output var with NO startup initializer: first run's
+        # input state lacks it, the step's output state includes it
+        blk = main.global_block()
+        acc = blk.create_var(name="step_sum_acc", shape=[1],
+                             dtype="float32", persistable=True)
+        fluid.layers.assign(s, output=acc)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(loss_name=s.name, main_program=main)
+        (v1,) = pexe.run(fetch_list=[s], feed={"x": X})
+        # the created persistable landed in the scope with the step's value
+        got = float(np.ravel(np.asarray(fluid.global_scope()["step_sum_acc"]))[0])
+        assert abs(got - float(np.ravel(v1).sum())) < 1e-3
+        # and a second run (state now INCLUDES the var -> new jit key,
+        # pinned path) still works
+        (v2,) = pexe.run(fetch_list=[s], feed={"x": X})
+        np.testing.assert_allclose(np.ravel(v2), np.ravel(v1), rtol=1e-5)
