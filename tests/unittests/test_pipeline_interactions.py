@@ -15,7 +15,7 @@ def _feeds(batch=16, seed=0):
             "y": rng.randn(batch, D).astype("float32")}
 
 
-def _build(recompute=False, clip=False, decay=False):
+def _build(recompute=False, clip=False, decay=False, optimizer=None):
     fluid.unique_name.switch()
     main = fluid.Program()
     startup = fluid.Program()
@@ -36,7 +36,8 @@ def _build(recompute=False, clip=False, decay=False):
         if clip:
             fluid.clip.set_gradient_clip(
                 fluid.clip.GradientClipByGlobalNorm(clip_norm=0.1))
-        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        opt = optimizer() if optimizer else fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
     if recompute:
         main.enable_recompute(segments=2)
     return main, startup, loss
@@ -76,3 +77,33 @@ def test_pipeline_with_weight_decay_matches():
     np.testing.assert_allclose(pp, seq, rtol=2e-4, atol=1e-6)
     no_decay = _run(None, feeds, decay=False)
     assert not np.allclose(seq, no_decay)
+
+
+def test_pipeline_with_zero_sharding_matches():
+    """dp2 x pp4 + zero_stage=1 (Adam): stage-stacked params stay
+    pp-sharded on the stage axis while their Adam moments additionally
+    dp-partition; numerics equal the sequential run."""
+    import jax
+
+    from test_pipeline_pp import _run_losses
+    from test_zero_sharding import _spec_axes as axes
+
+    assert jax.device_count() >= 8
+
+    adam = lambda: fluid.optimizer.Adam(learning_rate=0.05)  # noqa: E731
+    build = lambda: _build(optimizer=adam)  # noqa: E731
+    feeds = _feeds(seed=5)
+    X, Y = feeds["x"], feeds["y"]
+
+    seq = _run_losses(build, None, X, Y, 3)
+    zpp, specs = _run_losses(build, {"dp": 2, "pp": S}, X, Y, 3,
+                             zero_stage=1, collect_specs=True)
+    np.testing.assert_allclose(zpp, seq, rtol=2e-4, atol=1e-6)
+
+    moments = {n: s for n, s in specs.items() if "_moment" in n}
+    assert moments
+    for n, s in moments.items():
+        assert {"pp", "dp"} <= axes(s), (n, s)  # stage axis AND zero
+    # the parameter itself: pp only at stage 1
+    w = [s for n, s in specs.items() if n.endswith(".w_0")]
+    assert w and all("pp" in axes(s) and "dp" not in axes(s) for s in w), w

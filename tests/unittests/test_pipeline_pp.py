@@ -37,15 +37,19 @@ def _data(batch=32, seed=0):
             rng.randn(batch, D).astype("float32"))
 
 
-def _run_losses(build_fn, mesh, X, Y, steps, collect_params=False):
+def _run_losses(build_fn, mesh, X, Y, steps, collect_params=False,
+                zero_stage=0, collect_specs=False):
     """Shared seq-vs-ParallelExecutor harness: train ``steps`` on a fresh
-    program/scope; mesh=None runs the plain Executor (sequential path)."""
+    program/scope; mesh=None runs the plain Executor (sequential path).
+    ``collect_specs`` additionally returns {var: PartitionSpec} for every
+    sharded scope array (ZeRO/pp assertions)."""
     main, startup, loss = build_fn()
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
         runner = (fluid.ParallelExecutor(loss_name=loss.name,
-                                         main_program=main, mesh_shape=mesh)
+                                         main_program=main, mesh_shape=mesh,
+                                         zero_stage=zero_stage)
                   if mesh else exe)
         losses = []
         for _ in range(steps):
@@ -61,7 +65,17 @@ def _run_losses(build_fn, mesh, X, Y, steps, collect_params=False):
                     fluid.global_scope().find_var(p.name).get_tensor())
                 for p in main.global_block().all_parameters()
             }
-    return (losses, params) if collect_params else losses
+        specs = None
+        if collect_specs:
+            specs = {n: v.sharding.spec
+                     for n, v in fluid.global_scope().vars.items()
+                     if hasattr(getattr(v, "sharding", None), "spec")}
+    out = [losses]
+    if collect_params:
+        out.append(params)
+    if collect_specs:
+        out.append(specs)
+    return out[0] if len(out) == 1 else tuple(out)
 
 
 def test_pipeline_param_is_stacked():
