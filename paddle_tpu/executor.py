@@ -665,6 +665,10 @@ class Executor:
         self._mesh = build_mesh(mesh_spec, devices)
         self._sharding_rules = sharding_rules
         self._zero_stage = int(zero_stage or 0)
+        # compiled runners bake the mesh/shardings in, but the cache
+        # signature (program, feeds, fetches, state) doesn't carry them —
+        # drop anything compiled under the previous mesh config
+        self._cache.clear()
         return self._mesh
 
     # -- public API ----------------------------------------------------------

@@ -323,3 +323,31 @@ def test_mesh_runner_out_pinning_fallback_on_step_created_persistable():
         # pinned path) still works
         (v2,) = pexe.run(fetch_list=[s], feed={"x": X})
         np.testing.assert_allclose(np.ravel(v2), np.ravel(v1), rtol=1e-5)
+
+
+def test_attach_mesh_invalidates_compiled_cache():
+    """Runners compiled before attach_mesh bake in the old (no-mesh)
+    config; attaching a mesh must not serve them from the cache."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        s = fluid.layers.reduce_sum(fluid.layers.fc(input=x, size=4))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 8).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (v1,) = exe.run(main, feed={"x": X}, fetch_list=[s])
+        assert len(exe._cache) > 0
+        exe.attach_mesh({"dp": 8})
+        assert len(exe._cache) == 0  # stale single-device runner dropped
+        (v2,) = exe.run(main, feed={"x": X}, fetch_list=[s])
+        np.testing.assert_allclose(np.ravel(v2), np.ravel(v1), rtol=1e-5)
+        # the recompiled runner really is the mesh one: fc weight now
+        # carries a NamedSharding from the SPMD path
+        w = fluid.global_scope()["fc_0.w_0"]
+        assert hasattr(w.sharding, "spec")
