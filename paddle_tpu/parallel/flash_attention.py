@@ -31,6 +31,7 @@ back to the default backend under tracing.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import numpy as np
@@ -365,7 +366,14 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 # "auto" (the default) picks: fused where the calibrated VMEM model fits
 # AND T >= _FUSED_MIN_T (short T is latency-bound and scan wins), scan
 # elsewhere.
-FLASH_BWD_IMPL = "auto"
+FLASH_BWD_IMPL = os.environ.get("PADDLE_TPU_FLASH_BWD", "auto").strip().lower()
+if FLASH_BWD_IMPL not in ("auto", "scan", "fused", "pallas"):
+    import warnings
+
+    warnings.warn(
+        "PADDLE_TPU_FLASH_BWD=%r is not one of auto/scan/fused/pallas; "
+        "using 'auto'" % FLASH_BWD_IMPL)
+    FLASH_BWD_IMPL = "auto"
 # Backward-only key-block override (None = use the forward's block_k).
 # Shrinking ONLY the backward's block halves its [T, block_k] f32
 # intermediates without touching the forward kernel — the knob that could
