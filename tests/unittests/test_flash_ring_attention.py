@@ -217,3 +217,32 @@ def test_transformer_flash_matches_reference_path():
             (lv,) = exe.run(main, feed={"s": src, "t": trg, "l": lbl}, fetch_list=[avg])
         results[use_flash] = float(np.ravel(lv)[0])
     np.testing.assert_allclose(results[True], results[False], rtol=2e-4)
+
+
+def test_flash_bwd_env_override(tmp_path):
+    """PADDLE_TPU_FLASH_BWD seeds the engine choice at import (normalized,
+    invalid values warn and fall back to auto)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    code = ("from paddle_tpu.parallel import flash_attention as FA;"
+            "print('IMPL=' + FA.FLASH_BWD_IMPL)")
+
+    def run(val):
+        env = dict(os.environ, PADDLE_TPU_FLASH_BWD=val, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="",
+                   PYTHONPATH=os.pathsep.join(
+                       [root] + [p for p in (os.environ.get("PYTHONPATH"),) if p]))
+        out = subprocess.run([sys.executable, "-W", "always", "-c", code],
+                             env=env, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-1000:]
+        impl = [l for l in out.stdout.splitlines() if l.startswith("IMPL=")][0]
+        return impl[len("IMPL="):], out.stderr
+
+    impl, _ = run(" Fused ")
+    assert impl == "fused"
+    impl, err = run("bogus")
+    assert impl == "auto" and "PADDLE_TPU_FLASH_BWD" in err
