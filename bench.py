@@ -297,6 +297,14 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
                 except _q.Empty:
                     pass
                 t.join(0.05)
+        # past-deadline stragglers may still be mid-RPC; leave the queue
+        # empty so they can finish their final put() and see `stop`
+        # instead of blocking forever with their batches pinned
+        while True:
+            try:
+                on_device.get_nowait()
+            except _q.Empty:
+                break
 
     return {
         "metric": "resnet50_real_input_images_per_sec_per_chip",

@@ -78,7 +78,14 @@ def _quantized_mul(ctx, op):
 # contraction is MXU-worthy, dequant for thin-channel convs (e.g. the
 # RGB stem, whose per-tap K=3 matmuls would waste the 128-lane MXU),
 # and conv elsewhere/CPU.
-INT8_CONV_IMPL = os.environ.get("PADDLE_TPU_INT8_CONV_IMPL", "auto")
+INT8_CONV_IMPL = os.environ.get("PADDLE_TPU_INT8_CONV_IMPL", "auto").strip().lower()
+if INT8_CONV_IMPL not in ("auto", "matmul", "dequant", "conv"):
+    import warnings
+
+    warnings.warn(
+        "PADDLE_TPU_INT8_CONV_IMPL=%r is not one of auto/matmul/dequant/"
+        "conv; using 'auto'" % INT8_CONV_IMPL)
+    INT8_CONV_IMPL = "auto"
 _MATMUL_MIN_CIN = 16  # below this, per-tap K is too thin for the MXU
 
 

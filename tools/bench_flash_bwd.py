@@ -70,7 +70,8 @@ def main():
     print("\n| T | B | scan ms | fused ms | fused-bk64 ms | pair ms | winner |")
     print("|---|---|---|---|---|---|---|")
     for T, B, t in rows:
-        best = min((v, k_) for k_, v in t.items() if v == v)[1]
+        finite = [(v, k_) for k_, v in t.items() if v == v]
+        best = min(finite)[1] if finite else "all failed"
         print("| %d | %d | %.2f | %.2f | %.2f | %.2f | %s |"
               % (T, B, t.get("scan", float("nan")), t.get("fused", float("nan")),
                  t.get("fused64", float("nan")), t.get("pallas", float("nan")),

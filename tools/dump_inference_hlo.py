@@ -190,10 +190,21 @@ def int8_census(out_path):
     quantized convs execute as int8 MXU matmuls (s8 dot_generals with s32
     accumulation), not as slow integer convolutions (PERF.md round 5:
     the direct integer conv measured ~1% of bf16 throughput)."""
+    import jax
     import jax.numpy as jnp
 
     from paddle_tpu.contrib.quantize import Int8InferenceTranspiler
+    from paddle_tpu.contrib.quantize import int8_inference as int8_mod
     from paddle_tpu.jax_bridge import program_to_fn
+
+    # On TPU, census the REAL auto dispatch (matmul + thin-channel
+    # dequant).  Off-TPU auto picks the direct conv for every layer,
+    # which would make this structural check a guaranteed false alarm —
+    # pin the matmul decomposition there instead.
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    prev_impl = int8_mod.INT8_CONV_IMPL
+    if not on_tpu and prev_impl == "auto":
+        int8_mod.INT8_CONV_IMPL = "matmul"
 
     infer, state, predict = build_resnet_infer_program()
     s = dict(state)
@@ -224,6 +235,7 @@ def int8_census(out_path):
     else:
         print("=> %d integer convolutions present — check INT8_CONV_IMPL "
               "dispatch" % s8_convs)
+    int8_mod.INT8_CONV_IMPL = prev_impl
 
 
 if __name__ == "__main__":
