@@ -245,8 +245,11 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
             while not stop:
                 with host_lock:  # host-side decode/slice is not thread-safe
                     imgs, labels = next(batches)
+                # int64 labels, same as the synthetic leg: a differing label
+                # dtype would trace a second program and the two legs would
+                # no longer measure the same compiled step
                 on_device.put((jax.device_put(imgs),
-                               jax.device_put(labels.astype(np.int32))))
+                               jax.device_put(labels.astype(np.int64))))
         except StopIteration:
             pass
         except BaseException as e:  # noqa: BLE001

@@ -1,0 +1,191 @@
+"""Dispatch-overhead benchmark: Executor.run steps/s, fast path ON vs OFF.
+
+The Executor lowers a whole block to ONE jitted XLA computation, so for
+small models the per-step cost is host dispatch, not device compute.  This
+benchmark pins a number on that overhead in three regimes:
+
+  tiny_eval  : small MLP *evaluation* step (clone(for_test=True): no state
+               mutation).  The pure-overhead regime — every microsecond is
+               dispatch, and the fast path's bound-program cache plus
+               zero-state-output step shows its full effect.
+  tiny_train : the same tiny MLP as an SGD training step.  Params round-trip
+               through the step (donated device buffers), so the jit
+               call itself grows with param count; the fast path removes
+               the Python re-derivation around it.
+  realistic  : wider MLP with Adam at a realistic parameter count — shows
+               the overhead amortizing into real compute.
+
+"OFF" is the pre-PR dispatch loop: per-step feed-signature build,
+persistable-state collection through the scope owner chain, per-var
+write-back resolution, and eager (blocking) fetch conversion.  "ON" replays
+a bound-program entry and hands fetches back lazily.
+
+Usage:
+  python benchmarks/bench_dispatch.py            # full run, prints JSON
+  python benchmarks/bench_dispatch.py --smoke    # quick run + correctness
+                                                 # assertions (CI gate)
+
+CPU-friendly by design (JAX_PLATFORMS=cpu): dispatch overhead is a host
+property; the regression this guards does not need a TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_model(n_layers, width, optimizer):
+    """MLP regression program; returns dict(main, startup, test, loss)."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = x
+            for _ in range(n_layers):
+                h = fluid.layers.fc(h, size=width, act="relu")
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            if optimizer == "adam":
+                fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+            elif optimizer == "sgd":
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            # optimizer=None: evaluation-only program
+    test = main.clone(for_test=True)
+    return {"main": main, "startup": startup, "test": test, "loss": loss}
+
+
+def _feed(batch, width, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(batch, width).astype(np.float32),
+        "y": rng.randn(batch, 1).astype(np.float32),
+    }
+
+
+def run_regime(name, model_cfg, batch, iters, reps):
+    """Interleaved A/B: alternate fast/slow timing reps so machine-load
+    drift hits both legs equally; report best-of-``reps`` per leg."""
+    import paddle_tpu as fluid
+
+    model = build_model(*model_cfg)
+    program = model["test"] if name == "tiny_eval" else model["main"]
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    feed = _feed(batch, model_cfg[1])
+    fetch_list = [model["loss"]]
+    best = {False: float("inf"), True: float("inf")}
+    with fluid.scope_guard(scope):
+        exe.run(model["startup"])
+        for fast in (False, True):  # compile + bind before any timing
+            exe.fast_path = fast
+            for _ in range(8):
+                out = exe.run(program, feed=feed, fetch_list=fetch_list)
+            np.asarray(out[0])  # drain the async queue before timing
+        for _ in range(reps):
+            for fast in (False, True):
+                exe.fast_path = fast
+                for _ in range(3):
+                    exe.run(program, feed=feed, fetch_list=fetch_list)
+                np.asarray(
+                    exe.run(program, feed=feed, fetch_list=fetch_list)[0])
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = exe.run(program, feed=feed, fetch_list=fetch_list)
+                # materialize the last fetch: every dispatched step must
+                # complete inside the timed window (lazy fetches would
+                # otherwise let the fast leg stop the clock early)
+                np.asarray(out[0])
+                best[fast] = min(best[fast],
+                                 (time.perf_counter() - t0) / iters)
+    out = {
+        "slow_steps_per_s": round(1.0 / best[False], 1),
+        "fast_steps_per_s": round(1.0 / best[True], 1),
+    }
+    out["speedup"] = round(out["fast_steps_per_s"] / out["slow_steps_per_s"], 3)
+    out["persistable_vars"] = len(program.persistable_names())
+    return out
+
+
+def check_fast_path_semantics():
+    """Smoke assertions: the fast path must be semantically invisible and
+    actually engaged (a bound entry exists and hands back lazy fetches)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import LazyFetch
+
+    model = build_model(3, 8, "sgd")
+    feed = _feed(4, 8)
+    params = {}
+    for fast in (False, True):
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        exe.fast_path = fast
+        model["main"].random_seed = 1234
+        with fluid.scope_guard(scope):
+            np.random.seed(7)
+            exe.run(model["startup"])
+            for _ in range(5):
+                out = exe.run(model["main"], feed=feed,
+                              fetch_list=[model["loss"]])
+            params[fast] = {
+                n: np.asarray(scope[n]).copy()
+                for n in sorted(model["main"].persistable_names())
+                if n in scope
+            }
+        if fast:
+            assert exe._bound, "fast path never bound the program"
+            assert isinstance(out[0], LazyFetch), (
+                "fast path did not hand back a lazy fetch")
+        assert np.isfinite(float(np.asarray(out[0]))), "loss went non-finite"
+    for n in params[True]:
+        a, b = params[True][n], params[False][n]
+        assert a.tobytes() == b.tobytes(), (
+            "fast path changed parameter %r (max abs diff %g)"
+            % (n, float(np.max(np.abs(a.astype(np.float64)
+                                      - b.astype(np.float64))))))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true",
+                        help="quick pass: few iters + correctness checks")
+    parser.add_argument("--iters", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    if "JAX_PLATFORMS" not in os.environ and "JAX_PLATFORM_NAME" not in os.environ:
+        # dispatch overhead is a host property; default to CPU so the
+        # benchmark never contends for (or wedges) a TPU
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    check_fast_path_semantics()
+
+    reps = 2 if args.smoke else 5
+    regimes = {
+        # (layers, width, optimizer), batch, full-run iters
+        "tiny_eval": ((4, 8, "adam"), 4, 500),
+        "tiny_train": ((4, 8, "sgd"), 4, 500),
+        "realistic": ((4, 256, "adam"), 32, 100),
+    }
+    results = {"mode": "smoke" if args.smoke else "full"}
+    for name, (cfg, batch, iters) in regimes.items():
+        if args.iters:
+            iters = args.iters
+        elif args.smoke:
+            iters = max(30, iters // 10)
+        results[name] = run_regime(name, cfg, batch, iters, reps)
+    print(json.dumps(results, indent=2, sort_keys=True))
+    return results
+
+
+if __name__ == "__main__":
+    main()

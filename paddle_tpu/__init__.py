@@ -52,7 +52,8 @@ from .framework import (
     program_guard,
     name_scope,
 )
-from .executor import Executor, Scope, global_scope, scope_guard
+from .executor import (Executor, LazyFetch, Scope, enable_compilation_cache,
+                       global_scope, scope_guard)
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder

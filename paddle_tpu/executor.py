@@ -14,17 +14,33 @@ dispatch cost is zero and XLA fuses across the entire block.
 State (parameters, optimizer accumulators, BN running stats, step counters)
 lives in a ``Scope`` as device arrays and is threaded functionally through the
 step with buffer donation, so updates are in-place at the XLA level.
+
+Fast-path dispatch: once a (program, scope, fetch list) triple reaches
+steady state, ``run()`` replays a ``_BoundProgram`` entry — pre-resolved
+owner scopes, a per-feed shape/dtype plan, the compiled runner — instead
+of re-deriving the step from the Program.  State stays on device
+end-to-end, read-only state is neither donated nor returned, and
+``return_numpy=True`` fetches come back as ``LazyFetch`` values that pay
+the device->host copy on first access, so step N+1's dispatch never waits
+on step N's transfer.  Invalidation: ``program.version`` bump, any public
+scope mutation, feed shape/dtype drift.  ``PADDLE_TPU_FAST_PATH=0`` /
+``PADDLE_TPU_LAZY_FETCH=0`` are killswitches, and
+``PADDLE_TPU_COMPILATION_CACHE_DIR`` opts into a persistent XLA compile
+cache so warm-up survives process restarts (enable_compilation_cache).
 """
 from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import time
 import warnings
+import weakref
 
 import numpy as np
 
 from . import core
+from . import profiler as _prof
 from .framework import (
     GRAD_SUFFIX,
     Block,
@@ -40,7 +56,8 @@ from .registry import get_rule
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy"]
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy",
+           "LazyFetch", "enable_compilation_cache"]
 
 
 # ---------------------------------------------------------------------------
@@ -62,6 +79,7 @@ class _TensorShim:
 
     def set(self, value, place=None):
         self._scope.vars[self._name] = np.asarray(value)
+        self._scope._bump()
 
     def shape(self):
         return list(np.shape(self._scope.vars[self._name]))
@@ -84,6 +102,16 @@ class Scope:
         self.vars: dict[str, object] = {}
         self.kids: list["Scope"] = []
         self._parent: "Scope | None" = None
+        # Mutation counter for the executor's fast-path bound cache: any
+        # mutation through the public surface (setitem, shim set, var
+        # creation, drop) bumps it, invalidating bound entries whose owner
+        # resolution walked through this scope.  The executor's own step
+        # write-back intentionally does NOT bump — value updates from the
+        # compiled step are what the bound entry exists to serve.
+        self._version = 0
+
+    def _bump(self):
+        self._version += 1
 
     def new_scope(self) -> "Scope":
         """Child scope: lookups fall back to this scope (reference
@@ -114,7 +142,9 @@ class Scope:
         return _VarShim(owner, name) if owner is not None else None
 
     def var(self, name):
-        self.vars.setdefault(name, None)
+        if name not in self.vars:
+            self.vars[name] = None
+            self._bump()  # a new local can shadow an ancestor's binding
         return _VarShim(self, name)
 
     def __contains__(self, name):
@@ -128,6 +158,7 @@ class Scope:
 
     def __setitem__(self, name, value):
         self.vars[name] = value
+        self._bump()
 
     def keys(self):
         return self.vars.keys()
@@ -138,6 +169,7 @@ class Scope:
         — both directions, so stale handles stop resolving parent names and
         the parent's kids list doesn't retain dead scopes."""
         self.vars.clear()
+        self._bump()
         for kid in self.kids:
             kid._parent = None  # avoid double-detach walk
             kid.drop()
@@ -170,6 +202,197 @@ def as_numpy(tensor):
     if isinstance(tensor, _TensorShim):
         return np.asarray(tensor)
     return np.asarray(tensor)
+
+
+# ---------------------------------------------------------------------------
+# Lazy fetches + fast-path dispatch support
+# ---------------------------------------------------------------------------
+
+
+class LazyFetch:
+    """A fetched value that stays on device until first host access.
+
+    The executor fast path hands these back for ``return_numpy=True`` so
+    dispatch of step N+1 is not blocked behind step N's device->host copy —
+    the copy happens lazily, the first time the caller actually touches the
+    value.  Any numpy-style access (``np.asarray``, indexing, arithmetic,
+    attribute reads) materializes the host array and from then on behaves
+    exactly like the eagerly converted result.  Shape/dtype metadata is
+    served from the device array without forcing a sync.
+    """
+
+    __slots__ = ("_device_value", "_np")
+
+    def __init__(self, device_value):
+        self._device_value = device_value
+        self._np = None
+
+    def materialize(self):
+        if self._np is None:
+            self._np = np.asarray(self._device_value)
+            self._device_value = None
+        return self._np
+
+    @property
+    def shape(self):
+        v = self._np if self._np is not None else self._device_value
+        return tuple(v.shape)
+
+    @property
+    def dtype(self):
+        v = self._np if self._np is not None else self._device_value
+        return v.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.materialize()
+        if dtype is not None:
+            a = a.astype(dtype)
+        elif copy:
+            a = a.copy()
+        return a
+
+    def __repr__(self):
+        return repr(self.materialize())
+
+    def __str__(self):
+        return str(self.materialize())
+
+    def __getattr__(self, name):
+        if name in ("_np", "_device_value"):  # guard copy/pickle recursion
+            raise AttributeError(name)
+        # anything not handled above delegates to the materialized array
+        return getattr(self.materialize(), name)
+
+    # like ndarray: __eq__ is elementwise, so not hashable
+    __hash__ = None
+    # numpy defers binary ops to us instead of broadcasting the wrapper
+    __array_priority__ = 100.0
+
+
+def _lazy_unary(name):
+    def op(self):
+        return getattr(self.materialize(), name)()
+
+    op.__name__ = name
+    return op
+
+
+def _lazy_binary(name):
+    def op(self, other):
+        return getattr(self.materialize(), name)(other)
+
+    op.__name__ = name
+    return op
+
+
+for _name in ("__len__", "__iter__", "__float__", "__int__", "__bool__",
+              "__index__", "__neg__", "__pos__", "__abs__", "__invert__",
+              "__complex__"):
+    setattr(LazyFetch, _name, _lazy_unary(_name))
+for _name in ("__getitem__", "__eq__", "__ne__", "__lt__", "__le__",
+              "__gt__", "__ge__", "__add__", "__radd__", "__sub__",
+              "__rsub__", "__mul__", "__rmul__", "__truediv__",
+              "__rtruediv__", "__floordiv__", "__rfloordiv__", "__mod__",
+              "__rmod__", "__pow__", "__rpow__", "__matmul__",
+              "__rmatmul__", "__and__", "__rand__", "__or__", "__ror__",
+              "__xor__", "__rxor__", "__contains__"):
+    setattr(LazyFetch, _name, _lazy_binary(_name))
+del _name
+
+
+class _BoundProgram:
+    """A (program, scope, fetch list) binding resolved once, replayed every
+    step.  Caches everything ``run()`` otherwise re-derives per call: the
+    compiled runner, persistable-var owner scopes (direct references instead
+    of a ``list_vars()`` walk + ``_owner()`` chain search per var), the
+    write-back owner map, the RNG-key owner, and a per-feed plan (expected
+    shape/dtype + the cast, if any) so the hot loop only compares feed
+    shapes/dtypes instead of rebuilding the full signature tuple.
+
+    Invalidation: ``program.version`` bump, any public mutation of a scope
+    on the owner chain (``Scope._version``), a feed shape/dtype change, a
+    state var going missing/None, or NaN-debug toggling — each falls back
+    to the slow path, which re-derives and rebinds.
+
+    Scope references (scope, chain, owners) are WEAK: a bound entry must
+    never keep a dropped/abandoned scope's device arrays (a whole model's
+    parameters) alive — a dead weakref is just one more validation miss,
+    and the miss evicts the entry.  The program ref stays strong (host-side
+    metadata only; it is what keeps the id()-based cache key stable).
+    """
+
+    __slots__ = ("program", "scope", "version", "chain", "feed_plan",
+                 "state_owners", "wb_owners", "key_owner", "entry",
+                 "fetch_names", "eager_idx", "alias_cell", "nan_debug")
+
+
+def _scope_chain_token(scope):
+    chain = []
+    s = scope
+    while s is not None:
+        chain.append((s, s._version))
+        s = s._parent
+    return chain
+
+
+_BOUND_MISS = object()  # sentinel: bound validation failed, take slow path
+
+
+def enable_compilation_cache(cache_dir=None):
+    """Opt-in persistent XLA compilation cache: compiled executables are
+    written to ``cache_dir`` (or ``$PADDLE_TPU_COMPILATION_CACHE_DIR``) via
+    jax's ``jax_compilation_cache_dir``, so warm-up compiles survive process
+    restarts.  Returns True if the cache was enabled.  Also called lazily by
+    the first ``Executor()`` when the environment variable is set."""
+    from .core import safe_import_jax
+
+    jax = safe_import_jax()
+    cache_dir = cache_dir or os.environ.get("PADDLE_TPU_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception as e:  # pragma: no cover - jax without the option
+        warnings.warn("persistent compilation cache unavailable: %s" % e)
+        return False
+    # default thresholds skip tiny/fast compiles; persist everything —
+    # dispatch-bound training loops are exactly the small-program regime
+    for opt, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                     ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass
+    return True
+
+
+_compile_cache_checked = [False]
+
+_DONATION_WARNING_MSG = "Some donated buffers were not usable"
+
+
+def _filter_donation_warning_once():
+    """Suppress jax's per-dispatch 'Some donated buffers were not usable'
+    UserWarning (platforms without donation support) with a process-wide
+    filter instead of a per-call catch_warnings block — entering/exiting
+    that context dominated small-step dispatch time.  Re-checked on each
+    (cold) _build rather than latched once: a ``warnings.catch_warnings``
+    context (pytest wraps every test in one) pops filters registered
+    inside it, so the filter must self-heal; the presence check keeps the
+    filter list from growing one duplicate per compiled runner."""
+    for f in warnings.filters:
+        if f[0] == "ignore" and getattr(f[1], "pattern", None) == _DONATION_WARNING_MSG:
+            return
+    warnings.filterwarnings(
+        "ignore", message=_DONATION_WARNING_MSG, category=UserWarning)
 
 
 # ---------------------------------------------------------------------------
@@ -571,7 +794,7 @@ def lower_block(ctx: LoweringContext, block: Block):
                     keep=set(target_names) | set(tg_names)
                     | _ops_read_names(post)
                     | set(getattr(ctx, "keep_names", ()) or ())
-                    | {v.name for v in ctx.program.list_vars() if v.persistable})
+                    | ctx.program.persistable_names())
                 env2.clear()
                 env2.update(env3)
             else:
@@ -639,13 +862,22 @@ class Executor:
     """exe = Executor(TPUPlace()); exe.run(program, feed=..., fetch_list=...)"""
 
     _CACHE_CAP = 64  # compiled (program, shapes) entries kept per executor
+    _BOUND_CAP = 32  # fast-path bound (program, scope, fetches) entries
 
     def __init__(self, place=None):
         from .core import TPUPlace, safe_import_jax
 
         safe_import_jax()  # first jax import eats np.random state otherwise
+        if not _compile_cache_checked[0]:
+            _compile_cache_checked[0] = True
+            enable_compilation_cache()  # opt-in via env var, no-op otherwise
         self.place = place if place is not None else TPUPlace()
         self._cache: dict = {}
+        self._bound: dict = {}
+        # fast-path dispatch (bound-program cache + lazy fetches); both
+        # default on, killswitch via env for A/B and debugging
+        self.fast_path = os.environ.get("PADDLE_TPU_FAST_PATH", "1") != "0"
+        self.lazy_fetches = os.environ.get("PADDLE_TPU_LAZY_FETCH", "1") != "0"
         # set by ParallelExecutor: jax.sharding.Mesh for data-parallel SPMD;
         # a 2-D ("dp","tp") mesh additionally Megatron-shards parameters
         # (see parallel/tp.py), optionally refined by _sharding_rules
@@ -669,6 +901,7 @@ class Executor:
         # signature (program, feeds, fetches, state) doesn't carry them —
         # drop anything compiled under the previous mesh config
         self._cache.clear()
+        self._bound.clear()
         return self._mesh
 
     # -- public API ----------------------------------------------------------
@@ -687,11 +920,33 @@ class Executor:
         scope = scope or global_scope()
         feed = feed or {}
 
+        fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
+
+        # fast path: a prior run of this (program, scope, fetch list) bound
+        # the compiled runner to pre-resolved owner scopes and a feed plan;
+        # on a hit the whole per-step re-derivation below is skipped
+        bound_key = None
+        if use_program_cache and self.fast_path:
+            bound_key = (id(program), id(scope), tuple(fetch_names))
+            bound = self._bound.get(bound_key)
+            if type(bound) is _BoundProgram:
+                out = self._run_bound(bound, program, scope, feed, return_numpy)
+                if out is not _BOUND_MISS:
+                    # LRU touch: keep concurrently hot bindings resident
+                    del self._bound[bound_key]
+                    self._bound[bound_key] = bound
+                    return out
+                # a missed entry is stale; drop it now so it cannot pin
+                # anything until the slow path rebinds (or never, if this
+                # scope is on its way out)
+                self._bound.pop(bound_key, None)
+
         # started py_reader pipelines feed the step when the caller passes
         # no feed (the reference's in-graph reader semantics); an exhausted
         # pipeline raises core.EOFException out of run().  Items are pulled
         # from EVERY reader before any is consumed so one reader hitting
         # EOF pushes the others' items back instead of desynchronizing.
+        reader_fed = False
         if not feed:
             from .layers.io import program_readers
 
@@ -711,6 +966,7 @@ class Executor:
                 feed = {}
                 for _, item_feed in pulled:
                     feed.update(item_feed)
+                reader_fed = True
 
         # distributed programs: listen_and_serv blocks serving; send/recv
         # trainer programs run compute as one XLA step + host-side RPC round
@@ -724,8 +980,6 @@ class Executor:
 
             clients = self._pserver_clients(program)
             return pserver_runtime.run_trainer_step(self, program, feed, fetch_list, scope, clients)
-
-        fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
 
         feed_arrays = self._prepare_feed(program, feed)
         state_in = self._collect_state(program, scope)
@@ -751,8 +1005,6 @@ class Executor:
                     self._cache.pop(next(iter(self._cache)))  # oldest entry
                 self._cache[sig] = entry
 
-        from . import profiler as _prof
-
         if _prof.is_profiling():
             import jax
 
@@ -765,13 +1017,33 @@ class Executor:
         # write each updated var back to the scope that owns it (param
         # updates through a child scope must mutate the parent's param,
         # as in the reference); new names land in the local scope
+        wb_owners = {}
         for name, val in new_state.items():
             owner = scope._owner(name) or scope
             owner.vars[name] = val
+            wb_owners[name] = owner
         key_owner = scope._owner("__rng_key__") or scope
         key_owner.vars["__rng_key__"] = new_key
+
+        if bound_key is not None:
+            self._bind(bound_key, program, scope, feed, feed_arrays,
+                       state_in, new_state, wb_owners, key_owner, entry,
+                       fetch_names, reader_fed)
+        # slow path converts eagerly — exactly the pre-fast-path contract
+        return self._finalize_fetches(fetches, return_numpy, lazy=False,
+                                      eager_idx=())
+
+    def _finalize_fetches(self, fetches, return_numpy, lazy, eager_idx):
         if return_numpy:
-            return [np.asarray(v) for v, _ln, _sln in fetches]
+            if not lazy:
+                return [np.asarray(v) for v, _ln, _sln in fetches]
+            # lazy: dispatch of the next step is not blocked on this step's
+            # device->host copies; fetches that may alias donated state
+            # buffers (persistable names, or values the trace saw aliasing
+            # new_state) are materialized eagerly so a later step's buffer
+            # donation can never invalidate a value already handed out.
+            return [np.asarray(v) if i in eager_idx else LazyFetch(v)
+                    for i, (v, _ln, _sln) in enumerate(fetches)]
         # return_numpy=False: plain fetches stay DEVICE arrays; fetches
         # carrying ragged companions come back as host-side LoDArray (the
         # reference's fetched LoDTensors are host-side too) — that implies
@@ -785,6 +1057,128 @@ class Executor:
             else:
                 out.append(v)
         return out
+
+    # -- fast-path dispatch --------------------------------------------------
+    @staticmethod
+    def _is_plain_array(v):
+        """ndarray or jax device array — the feed kinds the fast path can
+        hand to the compiled runner without conversion."""
+        return isinstance(v, (np.ndarray, np.generic)) or (
+            type(v).__module__.split(".", 1)[0] in ("jax", "jaxlib"))
+
+    def _bind(self, bound_key, program, scope, feed, feed_arrays, state_in,
+              new_state, wb_owners, key_owner, entry, fetch_names,
+              reader_fed):
+        """Create/refresh the fast-path binding after a successful slow run.
+
+        Only steady-state runs bind: reader-driven feeds can't be replayed,
+        non-array feeds need per-step conversion, and a step that CREATED a
+        persistable (a new_state key absent from the incoming state) hasn't
+        settled — the next run's state set differs, so binding now would
+        replay a stale one."""
+        if reader_fed or not set(new_state) <= set(state_in):
+            return
+        plan = {}
+        for name, val in feed.items():
+            if isinstance(val, (LoDArray, tuple, list)) or not self._is_plain_array(val):
+                return
+            prepared = feed_arrays.get(name)
+            if prepared is None:
+                return
+            cast = prepared.dtype if str(prepared.dtype) != str(val.dtype) else None
+            plan[name] = (tuple(val.shape), val.dtype, cast)
+        if len(plan) != len(feed_arrays):  # ragged companions present
+            return
+
+        b = _BoundProgram()
+        b.program = program  # strong ref keeps the id()-based key stable
+        b.scope = weakref.ref(scope)
+        b.version = program.version
+        b.chain = [(weakref.ref(s), v) for s, v in _scope_chain_token(scope)]
+        b.feed_plan = plan
+        b.state_owners = [(n, weakref.ref(scope._owner(n))) for n in state_in]
+        b.wb_owners = {n: weakref.ref(o) for n, o in wb_owners.items()}
+        b.key_owner = weakref.ref(key_owner)
+        b.entry = entry
+        b.fetch_names = tuple(fetch_names)
+        persistable = program.persistable_names()
+        b.eager_idx = frozenset(
+            i for i, f in enumerate(fetch_names) if f in persistable)
+        b.alias_cell = getattr(entry, "_alias_cell", None)
+        b.nan_debug = _NAN_DEBUG["on"]
+        while len(self._bound) >= self._BOUND_CAP:
+            self._bound.pop(next(iter(self._bound)))  # oldest entry
+        self._bound.pop(bound_key, None)  # re-insert at the young end
+        self._bound[bound_key] = b
+
+    def _run_bound(self, bound, program, scope, feed, return_numpy):
+        """One step through the bound fast path; returns _BOUND_MISS when
+        any precondition drifted (program edited, scope mutated or died,
+        feed shape/dtype changed, state var gone) — caller evicts the
+        entry and falls back to the slow path, which re-derives everything
+        and rebinds."""
+        if bound.version != program.version or bound.nan_debug != _NAN_DEBUG["on"]:
+            return _BOUND_MISS
+        if bound.scope() is not scope:  # dead ref, or id() reuse after GC
+            return _BOUND_MISS
+        for sref, v in bound.chain:
+            s = sref()
+            if s is None or s._version != v:
+                return _BOUND_MISS
+        if _prof.is_profiling():
+            return _BOUND_MISS  # keep the slow path's instrumentation
+        plan = bound.feed_plan
+        if len(feed) != len(plan):
+            return _BOUND_MISS
+        feed_arrays = {}
+        for name, val in feed.items():
+            p = plan.get(name)
+            shape = getattr(val, "shape", None)
+            dtype = getattr(val, "dtype", None)
+            if (p is None or shape is None or dtype is None
+                    or tuple(shape) != p[0] or dtype != p[1]
+                    # non-plain feeds (LoDArray whose .shape/.dtype delegate
+                    # to .data, a LazyFetch fed back in, ...) go through the
+                    # slow path's full _prepare_feed, never a blind asarray
+                    or not self._is_plain_array(val)):
+                return _BOUND_MISS
+            if p[2] is not None:
+                val = np.asarray(val).astype(p[2])
+            feed_arrays[name] = val
+        state_in = {}
+        for name, oref in bound.state_owners:
+            owner = oref()
+            if owner is None:
+                return _BOUND_MISS
+            v = owner.vars.get(name)
+            if v is None:
+                return _BOUND_MISS
+            state_in[name] = v
+        key_owner = bound.key_owner()
+        if key_owner is None:
+            return _BOUND_MISS
+        key = key_owner.vars.get("__rng_key__")
+        if key is None:
+            return _BOUND_MISS
+
+        fetches, new_state, new_key = bound.entry(state_in, feed_arrays, key)
+
+        wb = bound.wb_owners
+        for name, val in new_state.items():
+            oref = wb.get(name)
+            owner = oref() if oref is not None else None
+            if owner is None:  # defensive: retrace surfaced a new name
+                owner = scope._owner(name) or scope
+                wb[name] = weakref.ref(owner)
+            owner.vars[name] = val
+        key_owner.vars["__rng_key__"] = new_key
+
+        eager = bound.eager_idx
+        cell = bound.alias_cell
+        if cell is not None and cell.get("idx"):
+            eager = eager | cell["idx"]
+        return self._finalize_fetches(fetches, return_numpy,
+                                      lazy=self.lazy_fetches, eager_idx=eager)
 
     # -- internals -----------------------------------------------------------
     def _pserver_clients(self, program):
@@ -867,12 +1261,10 @@ class Executor:
         (reference Scope::FindVar), so a new_scope() child sees the
         parent's parameters."""
         state = {}
-        for v in program.list_vars():
-            if not v.persistable:
-                continue
-            owner = scope._owner(v.name)
-            if owner is not None and owner.vars[v.name] is not None:
-                state[v.name] = owner.vars[v.name]
+        for name in program.persistable_names():
+            owner = scope._owner(name)
+            if owner is not None and owner.vars[name] is not None:
+                state[name] = owner.vars[name]
         return state
 
     def _rng_key(self, program, scope):
@@ -895,9 +1287,22 @@ class Executor:
     def _build(self, program, feed_names, fetch_names, state_names):
         import jax
 
-        persistable_names = {v.name for v in program.list_vars() if v.persistable}
+        persistable_names = program.persistable_names()
+        # a fetch that aliases a state output (fetching a param directly, or
+        # an assign of one) must not be handed out lazily: the next step
+        # donates the state buffer and would invalidate the fetch before the
+        # caller reads it.  Tracer identity at trace time records exactly
+        # which fetch indices alias; the fast path materializes those
+        # eagerly.  Populated on (re)trace, so the cell is shared with the
+        # runner via an attribute.
+        alias_cell = {"idx": None}
 
-        def step(state, feeds, key):
+        def trace_step(state, feeds, key):
+            """One symbolic step.  Returns, beyond the fetches/state/key, the
+            set of persistable names the block actually WROTE (tracer
+            identity vs the input) — pass-through state can then stay out of
+            the jit outputs entirely, which is what makes eval/inference
+            loops dispatch in O(1) instead of O(params)."""
             use_key, next_key = jax.random.split(key)
             env = {}
             env.update(state)
@@ -916,20 +1321,79 @@ class Executor:
                 fetches.append(
                     (env[f], env.get(f + "@LENGTHS"), env.get(f + "@SUBLENGTHS")))
             new_state = {n: v for n, v in env.items() if n in persistable_names}
-            return fetches, new_state, next_key
+            written = {n for n, v in new_state.items() if v is not state.get(n)}
+            # a fetch aliasing a state OUTPUT shares the buffer a later
+            # step donates; one aliasing a state INPUT (assign of a param,
+            # the param itself in an eval step) may share the scope-held
+            # buffer a later *training* step donates.  Both must be
+            # materialized eagerly by the fast path.
+            state_vals = list(new_state.values()) + list(state.values())
+            alias = frozenset(
+                i for i, (v, _ln, _sln) in enumerate(fetches)
+                if any(v is sv for sv in state_vals))
+            prev = alias_cell["idx"]
+            alias_cell["idx"] = alias if prev is None else (prev | alias)
+            return fetches, new_state, written, next_key
 
         mesh = self._mesh
         if mesh is None:
-            jitted = jax.jit(step, donate_argnums=(0,))
+            # Non-mesh runner: state is split into the MUTATED subset
+            # (donated, returned) and the READ-ONLY rest (plain inputs,
+            # never donated — donating them would let XLA recycle their
+            # buffers for same-shaped outputs and kill the scope's copy,
+            # and returning them would pay one output ArrayImpl per var per
+            # step for values that never change).  The written set is
+            # discovered exactly, by one abstract trace (no compile) on the
+            # first call.
+            cells = {"mut": None, "mut_set": None}
+
+            def probe(state, feeds, key):
+                _, _, written, _ = trace_step(state, feeds, key)
+                cells["mut"] = tuple(sorted(written))
+                cells["mut_set"] = frozenset(written)
+                return 0
+
+            def split_step(mut, ro, feeds, key):
+                state = dict(ro)
+                state.update(mut)
+                fetches, new_state, written, next_key = trace_step(state, feeds, key)
+                out_names = cells["mut"]
+                extra = [n for n in written if n not in cells["mut_set"]]
+                if extra:
+                    raise RuntimeError(
+                        "internal: retrace wrote persistables %s not seen by "
+                        "the discovery trace" % extra)
+                new_mut = {n: new_state[n] for n in out_names if n in new_state}
+                return fetches, new_mut, next_key
+
+            jitted = jax.jit(split_step, donate_argnums=(0,))
             device = self.place.jax_device()
+            _filter_donation_warning_once()
+            is_default_device = device == jax.devices()[0]
 
             def runner(state, feeds, key):
-                with warnings.catch_warnings():
-                    warnings.simplefilter("ignore")  # donation unsupported on cpu
-                    with jax.default_device(device):
-                        return jitted(state, feeds, key)
+                mut_set = cells["mut_set"]
+                if mut_set is None:
+                    jax.eval_shape(probe, state, feeds, key)
+                    mut_set = cells["mut_set"]
+                mut = {}
+                ro = {}
+                for n, v in state.items():
+                    if n in mut_set:
+                        mut[n] = v
+                    else:
+                        ro[n] = v
+                if is_default_device:
+                    return jitted(mut, ro, feeds, key)
+                with jax.default_device(device):
+                    return jitted(mut, ro, feeds, key)
 
+            runner._alias_cell = alias_cell
             return runner
+
+        def step(state, feeds, key):
+            fetches, new_state, _written, next_key = trace_step(state, feeds, key)
+            return fetches, new_state, next_key
 
         # SPMD: feeds batch-sharded on 'dp'; state replicated on a 1-D mesh,
         # or Megatron tp-sharded (parallel/tp.py) when the mesh carries a
@@ -1078,9 +1542,20 @@ class Executor:
                 except (TypeError, ValueError):
                     if not cell.get("out_pinned"):
                         raise
-                    # new_state's structure differs from state's (step
-                    # creates a persistable): re-jit without pinned
-                    # outputs; a genuine user error re-raises identically
+                    # Only the documented structure-change case falls back
+                    # (the step CREATES a persistable, so new_state's keys
+                    # differ from state's and the pinned out_shardings
+                    # pytree no longer matches).  Verify by abstract
+                    # evaluation — cheap, no compile — and re-raise
+                    # genuine user errors instead of silently re-jitting
+                    # down the unpinned path.
+                    try:
+                        _, ns_aval, _ = jax.eval_shape(step, state, feeds, key)
+                        structure_changed = set(ns_aval) != set(state)
+                    except Exception:
+                        structure_changed = False  # original error stands
+                    if not structure_changed:
+                        raise
                     cell["jit"] = jax.jit(
                         step, in_shardings=cell["in_sh"], donate_argnums=(0,))
                     cell["out_pinned"] = False
@@ -1091,12 +1566,14 @@ class Executor:
             # scope state between runs conforms to the declared shardings
             return fetches, conform(new_state), next_key
 
+        runner._alias_cell = alias_cell
         return runner
 
     def close(self):
         """Drop compiled executables and notify pservers this trainer is done
         (reference: Executor.close sends the barrier/exit RPC)."""
         self._cache.clear()
+        self._bound.clear()
         for c in getattr(self, "_ps_clients", {}).values():
             c.shutdown_server()
             c.close()

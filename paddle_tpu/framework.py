@@ -90,11 +90,28 @@ class Variable:
         self.shape = tuple(int(s) for s in shape) if shape is not None else None
         self.dtype = core.canonical_dtype(dtype) if dtype is not None else None
         self.lod_level = lod_level
-        self.persistable = persistable
+        self._persistable = bool(persistable)
         self.stop_gradient = stop_gradient
         self.is_data = is_data
         self.type = type
         self.op = None  # producing op, set by append_op
+
+    @property
+    def persistable(self):
+        return self._persistable
+
+    @persistable.setter
+    def persistable(self, value):
+        """Flag flips must invalidate Program.persistable_names()'s
+        version-keyed cache (and with it the executor's state collection),
+        so `var.persistable = True` after a first run is not silently
+        ignored."""
+        value = bool(value)
+        if value != getattr(self, "_persistable", None):
+            self._persistable = value
+            prog = getattr(getattr(self, "block", None), "program", None)
+            if prog is not None:
+                prog._bump()
 
     # -- numpy-ish sugar so layers compose naturally (math_op_patch.py) ------
     def __add__(self, other):
@@ -441,6 +458,18 @@ class Program:
             json.dumps(self.to_dict(), sort_keys=True).encode()).hexdigest()
         self._fingerprint_cache = (self._version, fp)
         return fp
+
+    def persistable_names(self):
+        """Names of every persistable var, cached until the version bumps.
+        The executor reads this on every ``run()`` (state collection and
+        the compiled step's new-state filter); without the cache each call
+        re-walks ``list_vars()`` over all blocks."""
+        cached = getattr(self, "_persistable_cache", None)
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        names = frozenset(v.name for v in self.list_vars() if v.persistable)
+        self._persistable_cache = (self._version, names)
+        return names
 
     def block(self, idx: int) -> Block:
         return self.blocks[idx]

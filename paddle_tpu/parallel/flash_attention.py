@@ -178,8 +178,8 @@ def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, T, D), q.dtype),
             jax.ShapeDtypeStruct((bh, T, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(lens_bh, qr, kr, vr)
@@ -350,6 +350,13 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:, :].astype(dq_ref.dtype)
 
 
+def _tpu_compiler_params(pltpu, **kwargs):
+    """pltpu.CompilerParams across jax versions (older releases spell it
+    TPUCompilerParams)."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 # Backward engine switch.  Measured on v5e.  Round 3 (fwd+bwd, causal,
 # H=8 D=64, tokens held at 16k): scan 9.9/11.6/14.7/20.8 ms vs the
 # two-kernel pallas pair 11.1/13.2/18.1/27.6 ms at T=256/512/1024/2048 —
@@ -381,7 +388,12 @@ if FLASH_BWD_IMPL not in ("auto", "scan", "fused", "pallas"):
 # measures whether the half-width lanes pay for themselves).
 FLASH_BWD_BLOCK_K = None
 _FUSED_MIN_T = 2048
-_FUSED_VMEM_BUDGET = 14 * 1024 * 1024  # 16MB/core scoped limit − margin
+# 16MB/core scoped limit − margin.  14MB left only ~3% headroom on the one
+# calibrated shape (T=2048 D=64 bf16 bk=128 reports 16.70M/16M at T=4096);
+# 13MB keeps ~19% margin so model error can't push a "fits" verdict into a
+# compile-time OOM — and _fused_bwd_compiles() below is the belt to this
+# suspenders: a RESOURCE_EXHAUSTED probe compile falls back to scan.
+_FUSED_VMEM_BUDGET = 13 * 1024 * 1024
 
 
 def _fused_bwd_vmem_bytes(T, D, in_itemsize, block_k):
@@ -403,6 +415,69 @@ def _fused_bwd_vmem_bytes(T, D, in_itemsize, block_k):
     return T * per_token + kv
 
 
+def _is_resource_exhausted(err) -> bool:
+    """True only for capacity misses (the RESOURCE_EXHAUSTED status or the
+    Mosaic scoped-VMEM OOM phrasings) — a genuine lowering/layout bug whose
+    message merely *mentions* vmem must NOT be demoted to the scan engine,
+    it has to surface."""
+    msg = str(err).lower()
+    return ("resource_exhausted" in msg or "resource exhausted" in msg
+            or "ran out of memory" in msg
+            or "scoped allocation" in msg
+            or "exceeds the vmem limit" in msg
+            or "exceeded vmem" in msg)
+
+
+# probe-compile verdicts keyed by (shapes, dtypes, flags) — one real Mosaic
+# compile per distinct shape, then cached for the process lifetime
+_FUSED_COMPILE_OK: dict = {}
+
+
+def _fused_bwd_compiles(causal, sm_scale, block_k, res, do):
+    """Whether the fused backward actually compiles for these shapes.
+
+    The analytic VMEM model (_fused_bwd_vmem_bytes) is calibrated, not
+    exact — so the fused-engine compile itself is wrapped in a try/except:
+    a RESOURCE_EXHAUSTED (scoped-VMEM OOM) verdict falls back to the scan
+    engine instead of failing the whole step compile.  Probing is a real
+    ahead-of-time compile of JUST the backward kernel (abstract args, no
+    execution), done once per shape signature; any non-OOM error is
+    re-raised — it is a genuine bug, not a capacity miss."""
+    q = res[0]
+    key = (causal, float(sm_scale) if sm_scale else None, int(block_k),
+           tuple(tuple(x.shape) + (str(x.dtype),) for x in res if x is not None),
+           tuple(do.shape), str(do.dtype))
+    cached = _FUSED_COMPILE_OK.get(key)
+    if cached is not None:
+        return cached
+    import jax
+
+    if jax.default_backend() != "tpu":
+        # nothing to probe off-TPU: pallas either interprets or the real
+        # compile error is not a capacity question
+        _FUSED_COMPILE_OK[key] = True
+        return True
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), (res, do))
+    try:
+        jax.jit(
+            functools.partial(_flash_bwd_fused, causal, sm_scale, block_k, False)
+        ).lower(*abstract).compile()
+        ok = True
+    except Exception as e:  # noqa: BLE001 — classified below
+        if not _is_resource_exhausted(e):
+            raise
+        import warnings
+
+        warnings.warn(
+            "fused flash backward exceeds scoped VMEM for shape %s "
+            "(block_k=%d); falling back to the scan engine"
+            % (tuple(q.shape), block_k))
+        ok = False
+    _FUSED_COMPILE_OK[key] = ok
+    return ok
+
+
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     if FLASH_BWD_BLOCK_K:
         block_k = int(FLASH_BWD_BLOCK_K)
@@ -412,6 +487,9 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         T, D = q.shape[2], q.shape[3]
         fits = _fused_bwd_vmem_bytes(T, D, q.dtype.itemsize, min(block_k, k_len(res))) <= _FUSED_VMEM_BUDGET
         impl = "fused" if (T >= _FUSED_MIN_T and fits) else "scan"
+    if impl == "fused" and not interpret and not _fused_bwd_compiles(
+            causal, sm_scale, block_k, res, do):
+        impl = "scan"
     if impl == "fused":
         return _flash_bwd_fused(causal, sm_scale, block_k, interpret, res, do)
     if impl == "pallas":
@@ -541,8 +619,8 @@ def _flash_bwd_fused(causal, sm_scale, block_k, interpret, res, do):
             jax.ShapeDtypeStruct((bh, S, D), k.dtype),
             jax.ShapeDtypeStruct((bh, S, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(lens_bh, qr, kr, vr, dor, ld)
@@ -613,8 +691,8 @@ def _flash_bwd_pallas(causal, sm_scale, block_q, block_k, interpret, res, do):
             jax.ShapeDtypeStruct((bh, S, D), k.dtype),
             jax.ShapeDtypeStruct((bh, S, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(lens_bh, qr, kr, vr, orr, dor, lse_rep)
@@ -642,8 +720,8 @@ def _flash_bwd_pallas(causal, sm_scale, block_q, block_k, interpret, res, do):
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct((bh, T, D), q.dtype)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(lens_bh, qr, kr, vr, orr, dor, lse_rep)

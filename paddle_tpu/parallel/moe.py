@@ -60,8 +60,9 @@ def switch_moe(x, gate_w, expert_params, expert_fn, mesh, axis_name="ep",
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .collective import shard_map_compat
 
     E = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name])
     B = x.shape[0]
@@ -81,8 +82,8 @@ def switch_moe(x, gate_w, expert_params, expert_fn, mesh, axis_name="ep",
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
+    @shard_map_compat(
+        mesh=mesh,
         in_specs=(P(axis_name), P(), param_specs),
         out_specs=P(axis_name),
         check_vma=False,

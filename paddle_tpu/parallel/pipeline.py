@@ -75,8 +75,9 @@ def pipeline_apply_circular(stage_fn, stacked_params, x, mesh, n_microbatches,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .collective import shard_map_compat
 
     S = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name])
     R = int(repeats)
@@ -108,8 +109,8 @@ def pipeline_apply_circular(stage_fn, stacked_params, x, mesh, n_microbatches,
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     side_specs = jax.tree_util.tree_map(lambda _: P(), sides)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
+    @shard_map_compat(
+        mesh=mesh,
         in_specs=(param_specs, P(), side_specs),
         out_specs=P(),
         check_vma=False,
@@ -174,8 +175,9 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .collective import shard_map_compat
 
     S = int(dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name])
     B = x.shape[0]
@@ -195,8 +197,8 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, n_microbatches,
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     side_specs = jax.tree_util.tree_map(lambda _: P(), sides)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
+    @shard_map_compat(
+        mesh=mesh,
         in_specs=(param_specs, P(), side_specs),
         out_specs=P(),
         check_vma=False,

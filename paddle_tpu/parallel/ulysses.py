@@ -59,13 +59,14 @@ def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None):
 def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False, sm_scale=None):
     """jit + shard_map wrapper: q/k/v are global [B, H, T, D]; the T axis is
     sharded over ``axis_name`` of ``mesh``."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .collective import shard_map_compat
 
     spec = P(None, None, axis_name, None)
 
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    @shard_map_compat(
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )
     def _run(qs, ks, vs):
         return ulysses_attention(qs, ks, vs, axis_name, causal=causal, sm_scale=sm_scale)

@@ -135,7 +135,18 @@ class ParallelExecutor:
     def device_count(self):
         return self._mesh.devices.size
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    @property
+    def fast_path(self):
+        """Bound-program fast-path dispatch toggle (executor.Executor.fast_path):
+        steady-state runs skip the per-step feed/state re-derivation."""
+        return self._exe.fast_path
+
+    @fast_path.setter
+    def fast_path(self, enabled):
+        self._exe.fast_path = bool(enabled)
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
+            use_program_cache=True):
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, list):
             # reference accepted per-device feed lists; concatenate on batch
@@ -151,4 +162,5 @@ class ParallelExecutor:
             fetch_list=fetch_list,
             scope=self._scope,
             return_numpy=return_numpy,
+            use_program_cache=use_program_cache,
         )

@@ -116,7 +116,7 @@ class Trainer:
 
     def __init__(self, train_func, optimizer_func, param_path=None, place=None,
                  parallel=False, checkpoint_config=None, sharding_rules=None,
-                 zero_stage=0):
+                 zero_stage=0, use_program_cache=True):
         """``parallel``: False = single device; True = data-parallel over
         every device (the reference's ParallelExecutor-under-Trainer mode);
         a ``(dp, tp[, sp])`` tuple or ``{axis: size}`` dict = multi-axis
@@ -125,11 +125,20 @@ class Trainer:
         stages GPipe-style (one stage per device); an ``ep`` axis runs
         layers.switch_moe experts with all-to-all dispatch; ``zero_stage``
         (1 or 3) ZeRO-shards optimizer state (and, at 3, parameters) over
-        the ``dp`` axis."""
+        the ``dp`` axis.
+
+        ``use_program_cache``: keep the executor's compiled-program and
+        fast-path bound caches hot across steps (default).  On a cache hit
+        the train loop skips the per-step feed/state re-derivation
+        entirely, and step metrics come back as lazily-materialized
+        fetches — reading them in the event handler is what pays the
+        device->host copy, so a handler that only samples metrics every K
+        steps costs nothing on the other K-1."""
         from .core import TPUPlace
 
         self.place = place if place is not None else TPUPlace()
         self.parallel = parallel
+        self.use_program_cache = bool(use_program_cache)
         self.checkpoint_cfg = checkpoint_config
         self.scope = Scope()
         self.startup_program = Program()
@@ -192,7 +201,9 @@ class Trainer:
                     event_handler(begin)
                     fetch = self.train_func_outputs if begin.fetch_metrics else []
                     metrics = self.exe.run(
-                        self.train_program, feed=feeder.feed(data), fetch_list=fetch
+                        self.train_program, feed=feeder.feed(data),
+                        fetch_list=fetch,
+                        use_program_cache=self.use_program_cache,
                     )
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                     global_step += 1
@@ -225,8 +236,12 @@ class Trainer:
         count = 0
         with scope_guard(self.scope):
             for data in reader():
+                # the eval step mutates no state, so the fast path's bound
+                # entry dispatches it with zero state outputs — the hot
+                # shape for Executor fast-path dispatch
                 outs = self.exe.run(self.test_program, feed=feeder.feed(data),
-                                    fetch_list=self.train_func_outputs)
+                                    fetch_list=self.train_func_outputs,
+                                    use_program_cache=self.use_program_cache)
                 vals = [float(np.ravel(o)[0]) for o in outs]
                 accumulated = vals if accumulated is None else [a + v for a, v in zip(accumulated, vals)]
                 count += 1

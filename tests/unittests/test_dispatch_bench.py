@@ -1,0 +1,18 @@
+"""Tier-1 wiring for the dispatch-overhead benchmark: run the tools/ CI
+gate (which runs benchmarks/bench_dispatch.py --smoke on CPU in a clean
+subprocess) and fail on import/run errors, so the benchmark can't rot."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_dispatch_bench_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_dispatch_bench.py")],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        "check_dispatch_bench failed:\nstdout:\n%s\nstderr:\n%s"
+        % (proc.stdout, proc.stderr))
+    assert "dispatch bench smoke OK" in proc.stdout

