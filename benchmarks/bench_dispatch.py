@@ -74,8 +74,15 @@ def _feed(batch, width, seed=0):
 
 
 def run_regime(name, model_cfg, batch, iters, reps):
-    """Interleaved A/B: alternate fast/slow timing reps so machine-load
-    drift hits both legs equally; report best-of-``reps`` per leg."""
+    """Interleaved A/B: alternate timing reps across legs so machine-load
+    drift hits each equally; report best-of-``reps`` per leg.
+
+    Legs: "slow" (fast path off), "fast" (fast path on), "guard" (fast
+    path on + ``nan_guard=True`` — the on-device finiteness probe and
+    update gating compiled into the step).  The guard leg pins a number
+    on the resilience layer's steady-state overhead; with the guard off
+    the executable is byte-identical to pre-guard, so "fast" doubles as
+    the 0%-when-disabled check."""
     import paddle_tpu as fluid
 
     model = build_model(*model_cfg)
@@ -84,35 +91,44 @@ def run_regime(name, model_cfg, batch, iters, reps):
     exe = fluid.Executor()
     feed = _feed(batch, model_cfg[1])
     fetch_list = [model["loss"]]
-    best = {False: float("inf"), True: float("inf")}
+    legs = {"slow": (False, False), "fast": (True, False),
+            "guard": (True, True)}
+    best = {leg: float("inf") for leg in legs}
     with fluid.scope_guard(scope):
         exe.run(model["startup"])
-        for fast in (False, True):  # compile + bind before any timing
+        for fast, guard in legs.values():  # compile + bind before any timing
             exe.fast_path = fast
             for _ in range(8):
-                out = exe.run(program, feed=feed, fetch_list=fetch_list)
+                out = exe.run(program, feed=feed, fetch_list=fetch_list,
+                              nan_guard=guard)
             np.asarray(out[0])  # drain the async queue before timing
         for _ in range(reps):
-            for fast in (False, True):
+            for leg, (fast, guard) in legs.items():
                 exe.fast_path = fast
                 for _ in range(3):
-                    exe.run(program, feed=feed, fetch_list=fetch_list)
+                    exe.run(program, feed=feed, fetch_list=fetch_list,
+                            nan_guard=guard)
                 np.asarray(
-                    exe.run(program, feed=feed, fetch_list=fetch_list)[0])
+                    exe.run(program, feed=feed, fetch_list=fetch_list,
+                            nan_guard=guard)[0])
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    out = exe.run(program, feed=feed, fetch_list=fetch_list)
+                    out = exe.run(program, feed=feed, fetch_list=fetch_list,
+                                  nan_guard=guard)
                 # materialize the last fetch: every dispatched step must
                 # complete inside the timed window (lazy fetches would
                 # otherwise let the fast leg stop the clock early)
                 np.asarray(out[0])
-                best[fast] = min(best[fast],
-                                 (time.perf_counter() - t0) / iters)
+                best[leg] = min(best[leg],
+                                (time.perf_counter() - t0) / iters)
     out = {
-        "slow_steps_per_s": round(1.0 / best[False], 1),
-        "fast_steps_per_s": round(1.0 / best[True], 1),
+        "slow_steps_per_s": round(1.0 / best["slow"], 1),
+        "fast_steps_per_s": round(1.0 / best["fast"], 1),
+        "guard_steps_per_s": round(1.0 / best["guard"], 1),
     }
     out["speedup"] = round(out["fast_steps_per_s"] / out["slow_steps_per_s"], 3)
+    out["nan_guard_overhead_pct"] = round(
+        100.0 * (1.0 - out["guard_steps_per_s"] / out["fast_steps_per_s"]), 1)
     out["persistable_vars"] = len(program.persistable_names())
     return out
 
@@ -153,6 +169,28 @@ def check_fast_path_semantics():
             "fast path changed parameter %r (max abs diff %g)"
             % (n, float(np.max(np.abs(a.astype(np.float64)
                                       - b.astype(np.float64))))))
+
+    # nan_guard semantics: a clean guarded run matches unguarded bitwise
+    # and reports a True verdict; guard off reports no verdict at all
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    model["main"].random_seed = 1234
+    with fluid.scope_guard(scope):
+        np.random.seed(7)
+        exe.run(model["startup"])
+        for _ in range(5):
+            exe.run(model["main"], feed=feed, fetch_list=[model["loss"]],
+                    nan_guard=True)
+        assert exe.last_step_ok() is True, "clean step reported non-finite"
+        guarded = {
+            n: np.asarray(scope[n]).copy()
+            for n in sorted(model["main"].persistable_names()) if n in scope
+        }
+        exe.run(model["main"], feed=feed, fetch_list=[model["loss"]])
+        assert exe.last_step_ok() is None, "guard-off run produced a verdict"
+    for n in params[True]:
+        assert guarded[n].tobytes() == params[True][n].tobytes(), (
+            "nan_guard changed parameter %r on a clean run" % n)
 
 
 def main(argv=None):

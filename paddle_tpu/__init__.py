@@ -36,8 +36,9 @@ from . import transpiler
 from . import parallel
 from . import contrib
 from . import debugger
+from . import resilience
 from . import trainer as trainer_mod
-from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent, save_checkpoint, load_checkpoint)
+from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent, save_checkpoint, load_checkpoint, FailureMonitor)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, InferenceTranspiler, memory_optimize, release_memory
 from . import reader
 from . import recordio_writer
@@ -114,6 +115,8 @@ __all__ = [
     "Trainer",
     "Inferencer",
     "CheckpointConfig",
+    "FailureMonitor",
+    "resilience",
     "recordio_writer",
     "contrib",
     "transpiler",

@@ -41,6 +41,7 @@ import numpy as np
 
 from . import core
 from . import profiler as _prof
+from . import resilience
 from .framework import (
     GRAD_SUFFIX,
     Block,
@@ -331,7 +332,8 @@ class _BoundProgram:
 
     __slots__ = ("program", "scope", "version", "chain", "feed_plan",
                  "state_owners", "wb_owners", "key_owner", "entry",
-                 "fetch_names", "eager_idx", "alias_cell", "nan_debug")
+                 "fetch_names", "eager_idx", "alias_cell", "nan_debug",
+                 "guard")
 
 
 def _scope_chain_token(scope):
@@ -358,6 +360,26 @@ def enable_compilation_cache(cache_dir=None):
     cache_dir = cache_dir or os.environ.get("PADDLE_TPU_COMPILATION_CACHE_DIR")
     if not cache_dir:
         return False
+    # a corrupt/unwritable cache dir (a file squatting on the path, a dead
+    # mount, bad permissions) must degrade to running uncached — warm-up
+    # persistence is an optimization, never a reason executor setup fails
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        # per-process probe name: concurrent startups sharing the cache
+        # dir must not race on each other's probe write/remove
+        probe = os.path.join(cache_dir,
+                             ".paddle_tpu_cache_probe.%d" % os.getpid())
+        with open(probe, "w") as f:
+            f.write("ok")
+        try:
+            os.remove(probe)
+        except FileNotFoundError:
+            pass
+    except OSError as e:
+        warnings.warn(
+            "persistent compilation cache dir %r is unusable (%s); "
+            "continuing without a compile cache" % (cache_dir, e))
+        return False
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except Exception as e:  # pragma: no cover - jax without the option
@@ -375,6 +397,27 @@ def enable_compilation_cache(cache_dir=None):
 
 
 _compile_cache_checked = [False]
+
+def _retry_fresh_entry(entry, state_in, feed_arrays, key):
+    """First call of a freshly built entry is the compile: transient XLA
+    status codes there (RESOURCE_EXHAUSTED from a probe compile racing
+    real allocations, UNAVAILABLE during a runtime blip) retry with
+    backoff.  A failure AFTER execution started may have consumed the
+    donated state buffers — retrying would mask the real error with
+    'Array has been deleted' — so retry only while every state input is
+    still live."""
+
+    def classify(exc):
+        if not resilience.is_transient_xla_error(exc):
+            return False
+        return not any(
+            getattr(v, "is_deleted", lambda: False)()
+            for v in state_in.values())
+
+    policy = resilience.RetryPolicy(max_retries=2, base_delay=0.2,
+                                    max_delay=2.0, classify=classify)
+    return resilience.call_with_retry(entry, state_in, feed_arrays, key,
+                                      policy=policy)
 
 _DONATION_WARNING_MSG = "Some donated buffers were not usable"
 
@@ -874,6 +917,9 @@ class Executor:
         self.place = place if place is not None else TPUPlace()
         self._cache: dict = {}
         self._bound: dict = {}
+        # device-side result of the last nan_guard finiteness check; None
+        # when the last run had no guard (see last_step_ok)
+        self._last_guard_flag = None
         # fast-path dispatch (bound-program cache + lazy fetches); both
         # default on, killswitch via env for A/B and debugging
         self.fast_path = os.environ.get("PADDLE_TPU_FAST_PATH", "1") != "0"
@@ -915,10 +961,21 @@ class Executor:
         scope: Scope | None = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        nan_guard: bool = False,
     ):
+        """``nan_guard=True`` arms the on-device step guard: one fused
+        finiteness reduction over loss/gradients is compiled into the step
+        and a non-finite step's whole state update is skipped inside the
+        executable (parameters come back bitwise-unchanged).  The verdict
+        is readable afterwards via :meth:`last_step_ok`; guarded and
+        unguarded executables are cached separately, with the guard off
+        the compiled step has zero extra outputs, and a step that writes
+        no state (eval/inference) compiles identically guarded or not —
+        there is no update to skip, so last_step_ok stays None."""
         program = program or default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
+        nan_guard = bool(nan_guard)
 
         fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
 
@@ -927,7 +984,7 @@ class Executor:
         # on a hit the whole per-step re-derivation below is skipped
         bound_key = None
         if use_program_cache and self.fast_path:
-            bound_key = (id(program), id(scope), tuple(fetch_names))
+            bound_key = (id(program), id(scope), tuple(fetch_names), nan_guard)
             bound = self._bound.get(bound_key)
             if type(bound) is _BoundProgram:
                 out = self._run_bound(bound, program, scope, feed, return_numpy)
@@ -940,6 +997,11 @@ class Executor:
                 # anything until the slow path rebinds (or never, if this
                 # scope is on its way out)
                 self._bound.pop(bound_key, None)
+
+        # last_step_ok must never report a previous run's verdict: clear
+        # before any slow-path branch (distributed early returns, reader
+        # EOF, a raising entry) can skip the guarded set below
+        self._last_guard_flag = None
 
         # started py_reader pipelines feed the step when the caller passes
         # no feed (the reference's in-graph reader semantics); an exhausted
@@ -982,6 +1044,8 @@ class Executor:
             return pserver_runtime.run_trainer_step(self, program, feed, fetch_list, scope, clients)
 
         feed_arrays = self._prepare_feed(program, feed)
+        if resilience._feed_fault is not None:  # fault-injection harness
+            feed_arrays = resilience._feed_fault(feed_arrays)
         state_in = self._collect_state(program, scope)
         key = self._rng_key(program, scope)
 
@@ -992,28 +1056,39 @@ class Executor:
             tuple(sorted(state_in)),
             _NAN_DEBUG["on"],  # probes are baked into the executable
             int(getattr(program, "_recompute_segments", 0) or 0),
+            nan_guard,  # guard reductions/gating are baked in too
         )
         entry = self._cache.get(sig) if use_program_cache else None
+        call_entry = entry
         if entry is not None:
             # LRU touch: re-inserting keeps hot entries at the young end
             del self._cache[sig]
             self._cache[sig] = entry
         if entry is None:
-            entry = self._build(program, sorted(feed_arrays), fetch_names, sorted(state_in))
+            entry = self._build(program, sorted(feed_arrays), fetch_names,
+                                sorted(state_in), nan_guard=nan_guard)
             if use_program_cache:
                 while len(self._cache) >= self._CACHE_CAP:
                     self._cache.pop(next(iter(self._cache)))  # oldest entry
                 self._cache[sig] = entry
+            # first call compiles: retry transient XLA setup failures
+            call_entry = lambda *a: _retry_fresh_entry(entry, *a)  # noqa: E731
 
         if _prof.is_profiling():
             import jax
 
             t0 = time.perf_counter()
-            fetches, new_state, new_key = entry(state_in, feed_arrays, key)
+            fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
             jax.block_until_ready(fetches)
             _prof.record("executor.run[prog@%x v%d]" % (id(program), program.version), time.perf_counter() - t0)
         else:
-            fetches, new_state, new_key = entry(state_in, feed_arrays, key)
+            fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
+        if nan_guard and getattr(entry, "_guard_cell", {}).get("emits"):
+            # the guard verdict rides as an extra trailing pseudo-fetch;
+            # peel it off before anything sees the fetch list (guard off,
+            # or a no-state step: the flag stays None from the reset above)
+            self._last_guard_flag = fetches[-1][0]
+            fetches = fetches[:-1]
         # write each updated var back to the scope that owns it (param
         # updates through a child scope must mutate the parent's param,
         # as in the reference); new names land in the local scope
@@ -1028,10 +1103,21 @@ class Executor:
         if bound_key is not None:
             self._bind(bound_key, program, scope, feed, feed_arrays,
                        state_in, new_state, wb_owners, key_owner, entry,
-                       fetch_names, reader_fed)
+                       fetch_names, reader_fed, nan_guard)
         # slow path converts eagerly — exactly the pre-fast-path contract
         return self._finalize_fetches(fetches, return_numpy, lazy=False,
                                       eager_idx=())
+
+    def last_step_ok(self):
+        """After a ``nan_guard=True`` run: the on-device finiteness verdict
+        for the last step (True = loss/grads finite, update applied;
+        False = non-finite, update skipped).  Materializing the scalar is
+        the caller's one host sync; returns None when the last run had no
+        guard."""
+        flag = self._last_guard_flag
+        if flag is None:
+            return None
+        return bool(np.asarray(flag))
 
     def _finalize_fetches(self, fetches, return_numpy, lazy, eager_idx):
         if return_numpy:
@@ -1068,7 +1154,7 @@ class Executor:
 
     def _bind(self, bound_key, program, scope, feed, feed_arrays, state_in,
               new_state, wb_owners, key_owner, entry, fetch_names,
-              reader_fed):
+              reader_fed, nan_guard=False):
         """Create/refresh the fast-path binding after a successful slow run.
 
         Only steady-state runs bind: reader-driven feeds can't be replayed,
@@ -1106,6 +1192,8 @@ class Executor:
             i for i, f in enumerate(fetch_names) if f in persistable)
         b.alias_cell = getattr(entry, "_alias_cell", None)
         b.nan_debug = _NAN_DEBUG["on"]
+        b.guard = bool(nan_guard
+                       and getattr(entry, "_guard_cell", {}).get("emits"))
         while len(self._bound) >= self._BOUND_CAP:
             self._bound.pop(next(iter(self._bound)))  # oldest entry
         self._bound.pop(bound_key, None)  # re-insert at the young end
@@ -1161,7 +1249,13 @@ class Executor:
         if key is None:
             return _BOUND_MISS
 
+        if resilience._feed_fault is not None:  # fault-injection harness
+            feed_arrays = resilience._feed_fault(feed_arrays)
+        self._last_guard_flag = None  # never report a previous run's verdict
         fetches, new_state, new_key = bound.entry(state_in, feed_arrays, key)
+        if bound.guard:
+            self._last_guard_flag = fetches[-1][0]
+            fetches = fetches[:-1]
 
         wb = bound.wb_owners
         for name, val in new_state.items():
@@ -1284,7 +1378,8 @@ class Executor:
             k = jax.random.PRNGKey(seed)
         return k
 
-    def _build(self, program, feed_names, fetch_names, state_names):
+    def _build(self, program, feed_names, fetch_names, state_names,
+               nan_guard=False):
         import jax
 
         persistable_names = program.persistable_names()
@@ -1296,6 +1391,10 @@ class Executor:
         # eagerly.  Populated on (re)trace, so the cell is shared with the
         # runner via an attribute.
         alias_cell = {"idx": None}
+        # whether the guarded step actually emits a verdict pseudo-fetch
+        # (False for steps that write no state — nothing to skip, so the
+        # guard compiles to a no-op); populated at trace time
+        guard_cell = {"emits": False}
 
         def trace_step(state, feeds, key):
             """One symbolic step.  Returns, beyond the fetches/state/key, the
@@ -1333,6 +1432,50 @@ class Executor:
                 if any(v is sv for sv in state_vals))
             prev = alias_cell["idx"]
             alias_cell["idx"] = alias if prev is None else (prev | alias)
+            if nan_guard:
+                # Step guard: ONE fused finiteness reduction over the
+                # parameter gradients + float fetches (the loss), then the
+                # whole state update is gated on-device — a bad step's
+                # parameters/optimizer state pass through bitwise-unchanged
+                # and no host sync happens unless the caller reads the
+                # verdict (last_step_ok).  The verdict rides as a trailing
+                # pseudo-fetch so the runner plumbing (mesh shardings,
+                # donation, lazy fetches) needs no second output structure.
+                # A step that writes NO state (eval/inference) has nothing
+                # to skip: the guard emits nothing and the executable is
+                # identical to the unguarded one (guard_cell records that,
+                # so run() knows not to pop a verdict).
+                import jax.numpy as jnp
+
+                gated = {}
+                gated_any = False
+                probes = None
+                for n, v in new_state.items():
+                    old = state.get(n)
+                    if (n in written and old is not None
+                            and getattr(old, "shape", None) == getattr(v, "shape", None)
+                            and getattr(old, "dtype", None) == getattr(v, "dtype", None)):
+                        if probes is None:
+                            probes = []
+                            for pname in persistable_names:
+                                g = env.get(grad_var_name(pname))
+                                if (g is not None and hasattr(g, "dtype")
+                                        and jnp.issubdtype(g.dtype, jnp.inexact)):
+                                    probes.append(jnp.sum(g.astype(jnp.float32)))
+                            for fv, _ln, _sln in fetches:
+                                if (hasattr(fv, "dtype")
+                                        and jnp.issubdtype(fv.dtype, jnp.inexact)):
+                                    probes.append(jnp.sum(fv.astype(jnp.float32)))
+                            good = (jnp.isfinite(jnp.stack(probes).sum())
+                                    if probes else jnp.asarray(True))
+                        gated[n] = jnp.where(good, v, old)
+                        gated_any = True
+                    else:
+                        gated[n] = v
+                guard_cell["emits"] = gated_any
+                if gated_any:
+                    new_state = gated
+                    fetches = fetches + [(good, None, None)]
             return fetches, new_state, written, next_key
 
         mesh = self._mesh
@@ -1389,6 +1532,7 @@ class Executor:
                     return jitted(mut, ro, feeds, key)
 
             runner._alias_cell = alias_cell
+            runner._guard_cell = guard_cell
             return runner
 
         def step(state, feeds, key):
@@ -1567,6 +1711,7 @@ class Executor:
             return fetches, conform(new_state), next_key
 
         runner._alias_cell = alias_cell
+        runner._guard_cell = guard_cell
         return runner
 
     def close(self):

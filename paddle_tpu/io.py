@@ -10,11 +10,18 @@ from __future__ import annotations
 
 import json
 import os
+from io import BytesIO
 
 import numpy as np
 
+from . import resilience
 from .executor import Executor, global_scope
 from .framework import Parameter, Program, Variable, default_main_program
+
+# transient-FS retry for every param file read/write (shared checkpoint
+# mounts hiccup; a clean retry beats losing a save)
+IO_RETRY_POLICY = resilience.RetryPolicy(
+    max_retries=2, base_delay=0.05, max_delay=0.5)
 
 __all__ = [
     "save_vars",
@@ -49,6 +56,29 @@ def _var_bytes(scope, name):
     return np.asarray(val)
 
 
+def _write_npy(path, arr):
+    """np.save through the resilience choke point: serialized in memory,
+    written with fsync + transient-error retry (fault-injectable)."""
+    buf = BytesIO()
+    np.save(buf, np.asarray(arr))
+    resilience.call_with_retry(
+        resilience.fs_write_bytes, path, buf.getvalue(), policy=IO_RETRY_POLICY)
+
+
+def _write_npz(path, arrays):
+    buf = BytesIO()
+    np.savez(buf, **arrays)
+    resilience.call_with_retry(
+        resilience.fs_write_bytes, path, buf.getvalue(), policy=IO_RETRY_POLICY)
+
+
+def _read_np(path):
+    """np.load (npy or npz) through the resilience choke point."""
+    data = resilience.call_with_retry(
+        resilience.fs_read_bytes, path, policy=IO_RETRY_POLICY)
+    return np.load(BytesIO(data), allow_pickle=False)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
     main_program = main_program or default_main_program()
     if vars is None:
@@ -57,11 +87,13 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
     os.makedirs(dirname, exist_ok=True)
     if filename is None:
         for v in vars:
-            np.save(os.path.join(dirname, v.name + ".npy"), _var_bytes(scope, v.name))
+            _write_npy(os.path.join(dirname, v.name + ".npy"), _var_bytes(scope, v.name))
     else:
-        np.savez(
+        if not filename.endswith(".npz"):
+            filename += ".npz"  # np.savez appended it; keep the layout
+        _write_npz(
             os.path.join(dirname, filename),
-            **{v.name: _var_bytes(scope, v.name) for v in vars},
+            {v.name: _var_bytes(scope, v.name) for v in vars},
         )
 
 
@@ -90,9 +122,9 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
                 arr, _lod = read_fluid_var_file(os.path.join(dirname, v.name))
                 scope[v.name] = arr
                 continue
-            scope[v.name] = np.load(path)
+            scope[v.name] = _read_np(path)
     else:
-        data = np.load(os.path.join(dirname, filename) + ("" if filename.endswith(".npz") else ".npz"))
+        data = _read_np(os.path.join(dirname, filename) + ("" if filename.endswith(".npz") else ".npz"))
         for v in vars:
             scope[v.name] = data[v.name]
 

@@ -9,6 +9,7 @@ from .decorator import (  # noqa: F401
     firstn,
     map_readers,
     multiprocess_reader,
+    retry_reader,
     shuffle,
     xmap_readers,
 )

@@ -1,0 +1,236 @@
+"""Transient-failure resilience: retry policies, error classification, and
+fault-injectable filesystem primitives.
+
+Long-running training on preemptible TPUs fails in boring, recoverable
+ways — a flaky checkpoint filesystem write, a RESOURCE_EXHAUSTED probe
+compile, a reader whose backing store hiccups.  The reference stack
+scattered ad-hoc retry loops through go/pserver and the trainer runtime;
+here the policy lives in ONE place and the checkpoint/executor/reader
+layers all share it:
+
+    from paddle_tpu import resilience
+
+    @resilience.retry(resilience.RetryPolicy(max_retries=5))
+    def flaky(): ...
+
+    resilience.call_with_retry(np.load, path)          # default policy
+
+Classification is explicit: programming errors (TypeError, KeyError, a
+missing checkpoint file) re-raise immediately; OS-level IO errors and the
+transient XLA status codes (RESOURCE_EXHAUSTED / UNAVAILABLE / ABORTED /
+DEADLINE_EXCEEDED) back off exponentially with jitter and retry.
+
+The ``fs_write_bytes`` / ``fs_read_bytes`` primitives are the single
+choke point for checkpoint file IO.  ``paddle_tpu.testing.faults``
+installs hooks on them (torn writes killed at byte k, intermittent
+IOError) so every recovery path is deterministically testable without
+monkeypatching ``open`` globally.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import random
+import time
+
+__all__ = [
+    "RetryPolicy",
+    "retry",
+    "call_with_retry",
+    "is_transient_error",
+    "is_transient_io_error",
+    "is_transient_xla_error",
+    "fs_write_bytes",
+    "fs_read_bytes",
+    "fsync_dir",
+]
+
+
+# ---------------------------------------------------------------------------
+# error classification
+# ---------------------------------------------------------------------------
+
+# XLA/PJRT status codes worth retrying: allocation pressure from a probe
+# compile, a runtime briefly unavailable during preemption, an aborted
+# collective.  INVALID_ARGUMENT and friends are programming errors.
+TRANSIENT_XLA_SUBSTRINGS = (
+    "RESOURCE_EXHAUSTED",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+)
+
+# OSError subclasses that mean "the thing is not there / is the wrong
+# kind", not "the IO path hiccupped" — retrying cannot help.
+_NON_TRANSIENT_OS = (
+    FileNotFoundError,
+    IsADirectoryError,
+    NotADirectoryError,
+    FileExistsError,
+)
+
+
+def is_transient_io_error(exc):
+    """IO errors worth retrying: any OSError that is not a definitive
+    does-not-exist / wrong-kind error."""
+    return isinstance(exc, OSError) and not isinstance(exc, _NON_TRANSIENT_OS)
+
+
+def is_transient_xla_error(exc):
+    """XLA runtime/compile errors carrying a transient status code."""
+    mod = type(exc).__module__ or ""
+    name = type(exc).__name__
+    if not ("xla" in mod or "jaxlib" in mod or name == "XlaRuntimeError"):
+        return False
+    msg = str(exc)
+    return any(s in msg for s in TRANSIENT_XLA_SUBSTRINGS)
+
+
+def is_transient_error(exc):
+    """Default classifier: transient IO or transient XLA."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return False
+    return is_transient_io_error(exc) or is_transient_xla_error(exc)
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+class RetryPolicy:
+    """Exponential backoff with bounded jitter.
+
+    ``max_retries`` is the number of RE-tries: a call may run at most
+    ``max_retries + 1`` times.  Delay before retry ``i`` (0-based) is
+    ``min(max_delay, base_delay * multiplier**i)`` scaled by a uniform
+    jitter factor in ``[1 - jitter, 1 + jitter]``.  ``classify(exc)``
+    decides retryability (default: :func:`is_transient_error`);
+    ``sleep``/``rng`` are injectable for deterministic tests.
+    """
+
+    def __init__(self, max_retries=3, base_delay=0.05, max_delay=2.0,
+                 multiplier=2.0, jitter=0.25, classify=None, sleep=None,
+                 rng=None):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.classify = classify or is_transient_error
+        self.sleep = sleep or time.sleep
+        self.rng = rng or random.Random()
+
+    def delays(self):
+        """The backoff schedule: one delay per retry attempt."""
+        for i in range(self.max_retries):
+            base = min(self.max_delay, self.base_delay * self.multiplier ** i)
+            if self.jitter:
+                base *= 1.0 + self.rng.uniform(-self.jitter, self.jitter)
+            yield max(0.0, base)
+
+
+_DEFAULT_POLICY = RetryPolicy()
+
+
+def call_with_retry(fn, *args, policy=None, on_retry=None, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+    Non-retryable errors (per ``policy.classify``) re-raise immediately;
+    retryable ones sleep the next backoff delay and re-run.  ``on_retry``
+    (if given) is called as ``on_retry(exc, attempt, delay)`` before each
+    sleep — the hook used for logging/telemetry.
+    """
+    policy = policy or _DEFAULT_POLICY
+    schedule = policy.delays()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as exc:
+            if not policy.classify(exc):
+                raise
+            try:
+                delay = next(schedule)
+            except StopIteration:
+                raise exc from None
+            if on_retry is not None:
+                on_retry(exc, attempt, delay)
+            policy.sleep(delay)
+            attempt += 1
+
+
+def retry(policy=None, on_retry=None):
+    """Decorator form of :func:`call_with_retry`::
+
+        @retry(RetryPolicy(max_retries=5))
+        def read_manifest(path): ...
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call_with_retry(fn, *args, policy=policy,
+                                   on_retry=on_retry, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# fault-injectable filesystem primitives
+# ---------------------------------------------------------------------------
+
+# Hooks installed by paddle_tpu.testing.faults; None on the happy path so
+# the cost is one attribute read.  _write_fault(path, data, fileobj) either
+# performs the (possibly partial) write itself and raises, or returns False
+# to let the normal write proceed.  _io_fault(path, op) raises to simulate
+# an intermittent error before the real IO runs.  _feed_fault(feed_arrays)
+# lets the fault harness poison executor feeds (forced-NaN steps).
+_write_fault = None
+_io_fault = None
+_feed_fault = None
+
+
+def fs_write_bytes(path, data, sync=True):
+    """Write ``data`` to ``path`` (followed by flush+fsync) through the
+    fault-injection choke point.  All checkpoint file writes go through
+    here so torn/flaky writes are injectable at an exact byte offset."""
+    if _io_fault is not None:
+        _io_fault(path, "write")
+    with open(path, "wb") as f:
+        if _write_fault is not None and _write_fault(path, data, f):
+            pass  # fault hook performed (part of) the write itself
+        else:
+            f.write(data)
+        f.flush()
+        if sync:
+            os.fsync(f.fileno())
+
+
+def fs_read_bytes(path):
+    """Read ``path`` fully, through the fault-injection choke point."""
+    if _io_fault is not None:
+        _io_fault(path, "read")
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def fsync_dir(dirname):
+    """fsync a directory so a rename/create inside it is durable (no-op on
+    platforms whose dirs can't be opened)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

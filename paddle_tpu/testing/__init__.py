@@ -1,0 +1,3 @@
+"""Testing utilities: deterministic fault injection for the resilience
+layer (``paddle_tpu.testing.faults``)."""
+from . import faults  # noqa: F401

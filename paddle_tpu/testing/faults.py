@@ -1,0 +1,157 @@
+"""Deterministic fault injection for the resilience layer.
+
+Every recovery behavior the runtime promises — torn-checkpoint fallback,
+transient-IO retry, NaN-step skipping — is only trustworthy if it can be
+triggered on demand.  These context managers install hooks on the
+``paddle_tpu.resilience`` choke points (checkpoint file IO, executor feed
+preparation) so tests reproduce the exact failure, at the exact byte/step,
+every run:
+
+    with faults.torn_write("checkpoint_4", at_byte=128):
+        save_checkpoint(...)            # raises; leaves a torn .tmp dir
+
+    with faults.flaky_io("params.npz", times=2):
+        save_checkpoint(...)            # first 2 writes fail; retry wins
+
+    with faults.nan_feeds(at_steps=[2]):
+        trainer.train(..., nan_guard=True)   # step 2's loss is NaN
+
+No global monkeypatching: only code routed through the resilience
+primitives (checkpoint IO, ``Executor.run`` feeds) sees the faults, and
+exiting the context always restores the hooks — the managers nest but not
+two of the same kind at once.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .. import resilience
+
+__all__ = [
+    "FaultInjected",
+    "torn_write",
+    "flaky_io",
+    "nan_feeds",
+    "flaky_reader",
+]
+
+
+class FaultInjected(IOError):
+    """Raised by injected faults; an OSError subclass so the default
+    transient classifier treats it exactly like a real flaky-FS error."""
+
+
+def _match(path, substr):
+    return substr in str(path)
+
+
+@contextlib.contextmanager
+def torn_write(match, at_byte):
+    """Kill the next write to a path containing ``match`` after exactly
+    ``at_byte`` bytes have hit the file — simulating a preemption mid
+    checkpoint write.  The partial bytes ARE written (and flushed), so the
+    torn file is really on disk; the write then raises FaultInjected.
+    Every subsequent matching write in the context is killed the same way
+    (a retry of the same doomed write also dies, like a dying host)."""
+    if resilience._write_fault is not None:
+        raise RuntimeError("a torn_write fault is already installed")
+    cut = int(at_byte)
+
+    def hook(path, data, fileobj):
+        if not _match(path, match):
+            return False
+        fileobj.write(data[:cut])
+        fileobj.flush()
+        raise FaultInjected(
+            "injected torn write: %r killed at byte %d of %d"
+            % (path, min(cut, len(data)), len(data)))
+
+    resilience._write_fault = hook
+    try:
+        yield
+    finally:
+        resilience._write_fault = None
+
+
+@contextlib.contextmanager
+def flaky_io(match, times=1, op=None, exc_factory=None):
+    """Fail the first ``times`` resilience-routed IO operations touching a
+    path that contains ``match`` (both reads and writes unless ``op`` is
+    "read"/"write"), then let everything succeed — the transient-FS-error
+    shape that retry policies exist for.  Yields a one-item list holding
+    the number of faults fired so far."""
+    if resilience._io_fault is not None:
+        raise RuntimeError("a flaky_io fault is already installed")
+    remaining = [int(times)]
+    fired = [0]
+    make_exc = exc_factory or (
+        lambda path, o: FaultInjected("injected %s error on %r" % (o, path)))
+
+    def hook(path, o):
+        if op is not None and o != op:
+            return
+        if not _match(path, match) or remaining[0] <= 0:
+            return
+        remaining[0] -= 1
+        fired[0] += 1
+        raise make_exc(path, o)
+
+    resilience._io_fault = hook
+    try:
+        yield fired
+    finally:
+        resilience._io_fault = None
+
+
+@contextlib.contextmanager
+def nan_feeds(at_steps=(0,)):
+    """Poison every float feed with NaN on the given ``Executor.run``
+    dispatches (0-based, counted from context entry).  The NaN flows
+    through the real compiled step — loss and gradients go non-finite on
+    device — which is exactly what the nan_guard must catch.  Yields a
+    one-item list with the dispatch count so far."""
+    if resilience._feed_fault is not None:
+        raise RuntimeError("a nan_feeds fault is already installed")
+    steps = frozenset(int(s) for s in at_steps)
+    count = [0]
+
+    def hook(feed_arrays):
+        idx = count[0]
+        count[0] += 1
+        if idx not in steps:
+            return feed_arrays
+        out = {}
+        for name, val in feed_arrays.items():
+            arr = np.asarray(val)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = np.full_like(arr, np.nan)
+            out[name] = arr
+        return out
+
+    resilience._feed_fault = hook
+    try:
+        yield count
+    finally:
+        resilience._feed_fault = None
+
+
+def flaky_reader(reader, fail_at, times=1, exc_factory=None):
+    """Wrap a reader creator so iteration raises just before yielding the
+    sample at absolute index ``fail_at`` — on the first ``times``
+    traversals only.  The deterministic partner of
+    ``reader.retry_reader``: recovery must resume at the exact sample
+    where the failure hit, with no duplicates and no drops."""
+    remaining = [int(times)]
+    make_exc = exc_factory or (
+        lambda i: FaultInjected("injected reader error at sample %d" % i))
+
+    def faulty():
+        for i, sample in enumerate(reader()):
+            if i == fail_at and remaining[0] > 0:
+                remaining[0] -= 1
+                raise make_exc(i)
+            yield sample
+
+    return faulty
