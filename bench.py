@@ -178,7 +178,6 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
     Normalization/cast runs inside the jitted step (fuses into the first
     conv) so the host ships uint8 — 4x less host RAM and host->device
     bandwidth, which matters doubly through the axon tunnel."""
-    import queue as _q
     import tempfile
 
     import jax
@@ -223,91 +222,48 @@ def bench_resnet_real_input(on_tpu, synthetic_ips):
 
     jitted = jax.jit(step, donate_argnums=(0,))
 
-    # infinite-epoch pipeline; SEVERAL transfer threads keep device_put
-    # ahead of the compute stream.  Through the axon tunnel each put pays
-    # an RPC round trip, so a single prefetch thread serializes
-    # latency·batches; concurrent puts pipeline it (double buffering
-    # covers plain PCIe hosts too).
+    # infinite-epoch pipeline through the shared async device-feed
+    # machinery (reader.device_prefetch).  SEVERAL transfer threads keep
+    # device_put ahead of the compute stream: through the axon tunnel
+    # each put pays an RPC round trip, so a single prefetch thread
+    # serializes latency·batches; concurrent puts pipeline it (double
+    # buffering covers plain PCIe hosts too).  The prefetcher serializes
+    # next() on the source (host-side decode/slice is not thread-safe)
+    # while transfers run unlocked, and close() drains/joins the threads
+    # deadline-capped so later (memory-hungry) legs never run with ~7
+    # batches still pinned on device.
+    from paddle_tpu.reader.device_prefetch import DevicePrefetcher
+
     reader = decoded_pipeline(shards, mode="train", image_size=224,
                               epochs=10_000, output="uint8")
     batches = batched_images(reader, batch)()
-    on_device: _q.Queue = _q.Queue(maxsize=4)
 
-    prefetch_err = []
-    stop = []  # non-empty = shut down (threads would otherwise keep ~7
-    #            batches pinned on device while later bench legs run)
-    import threading
+    def to_device(pair):
+        imgs, labels = pair
+        # int64 labels, same as the synthetic leg: a differing label
+        # dtype would trace a second program and the two legs would
+        # no longer measure the same compiled step
+        return {"data": jax.device_put(imgs),
+                "label": jax.device_put(labels.astype(np.int64))}
 
-    host_lock = threading.Lock()
-
-    def prefetch():
-        try:
-            while not stop:
-                with host_lock:  # host-side decode/slice is not thread-safe
-                    imgs, labels = next(batches)
-                # int64 labels, same as the synthetic leg: a differing label
-                # dtype would trace a second program and the two legs would
-                # no longer measure the same compiled step
-                on_device.put((jax.device_put(imgs),
-                               jax.device_put(labels.astype(np.int64))))
-        except StopIteration:
-            pass
-        except BaseException as e:  # noqa: BLE001
-            prefetch_err.append(e)
-            raise
-
-    threads = [threading.Thread(target=prefetch, daemon=True) for _ in range(3)]
-    for t in threads:
-        t.start()
-
-    def next_feed():
-        # liveness check on EVERY call: with several transfer threads the
-        # survivors keep the queue full, so a partial death would silently
-        # shrink the measured concurrency if only checked on queue-empty
-        while True:
-            if prefetch_err:
-                raise RuntimeError(
-                    "input prefetch thread died: %r" % (prefetch_err[0],))
-            try:
-                x, y = on_device.get(timeout=30.0)
-                return {"data": x, "label": y}
-            except _q.Empty:
-                if not any(t.is_alive() for t in threads):
-                    raise RuntimeError("input prefetch threads exited early")
-
+    feeds = DevicePrefetcher(batches, to_device, buffer_size=4,
+                             transfer_threads=3)
     try:
         for _ in range(3):  # warmup/compile
-            fetches, state = jitted(state, next_feed())
+            fetches, state = jitted(state, next(feeds))
         np.asarray(fetches[0])
         t0 = time.perf_counter()
         for _ in range(iters):
-            fetches, state = jitted(state, next_feed())
+            fetches, state = jitted(state, next(feeds))
         np.asarray(fetches[0])
         dt = time.perf_counter() - t0
         ips = batch * iters / dt
     finally:
-        # release the transfer threads and their pinned device batches
-        # before the later (memory-hungry long-context) legs run — on the
-        # error path too.  Deadline-capped: a thread wedged inside a
-        # device_put RPC must not hang a leg whose measurement is done
-        # (daemon threads die with the process anyway).
-        stop.append(True)
-        deadline = time.monotonic() + 5.0
-        for t in threads:
-            while t.is_alive() and time.monotonic() < deadline:
-                try:
-                    on_device.get_nowait()
-                except _q.Empty:
-                    pass
-                t.join(0.05)
-        # past-deadline stragglers may still be mid-RPC; leave the queue
-        # empty so they can finish their final put() and see `stop`
-        # instead of blocking forever with their batches pinned
-        while True:
-            try:
-                on_device.get_nowait()
-            except _q.Empty:
-                break
+        # release the transfer threads and their pinned device batches on
+        # the error path too; a thread wedged inside a device_put RPC is
+        # abandoned at the shutdown deadline (daemon threads die with the
+        # process anyway)
+        feeds.close()
 
     return {
         "metric": "resnet50_real_input_images_per_sec_per_chip",

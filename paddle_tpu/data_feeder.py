@@ -109,6 +109,21 @@ class DataFeeder:
         for i in range(n):
             yield self.feed(batch[i * per:(i + 1) * per])
 
+    def to_device_reader(self, reader, executor, program=None,
+                         buffer_size=2, transfer_threads=1):
+        """Wrap a sample-batch reader into a creator yielding ON-DEVICE
+        feed dicts: conversion (``self.feed``) and the host->device
+        transfer both run on a background thread, double-buffered, so
+        batch N+1 converts/transfers while the step for batch N computes
+        (reader.device_prefetch).  Placement follows the executor's
+        compiled-step plan — batch-sharded on the mesh's ``dp`` axis for
+        data vars, the executor's device otherwise."""
+        from .reader.device_prefetch import decorate_device_feed
+
+        return decorate_device_feed(reader, self, executor, program=program,
+                                    buffer_size=buffer_size,
+                                    transfer_threads=transfer_threads)
+
     def decorate_reader(self, reader, multi_devices, num_places=None, drop_last=True):
         """Wrap a sample reader into one yielding ready feed dicts
         (reference data_feeder.py decorate_reader).  With ``multi_devices``

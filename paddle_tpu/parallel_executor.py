@@ -131,6 +131,18 @@ class ParallelExecutor:
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
+    def _data_names(self):
+        """Declared data vars of the bound program, cached per program
+        version — the feed-list path runs per step and must not pay a
+        list_vars() walk each call."""
+        cached = getattr(self, "_data_names_cache", None)
+        version = getattr(self._program, "version", 0)
+        if cached is None or cached[0] != version:
+            names = {v.name for v in self._program.list_vars()
+                     if getattr(v, "is_data", False)}
+            self._data_names_cache = cached = (version, names)
+        return cached[1]
+
     @property
     def device_count(self):
         return self._mesh.devices.size
@@ -149,12 +161,16 @@ class ParallelExecutor:
             use_program_cache=True):
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, list):
-            # reference accepted per-device feed lists; concatenate on batch
-            merged = {}
-            for d in feed:
-                for k, v in d.items():
-                    merged.setdefault(k, []).append(np.asarray(v))
-            feed = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
+            # reference accepted per-device feed lists; instead of
+            # concatenating the full batch on host (one extra copy) and
+            # letting XLA re-split it, each data-var shard is device_put
+            # straight to its mesh device and stitched into one global
+            # array (reader.device_prefetch.shard_feed_list); non-data /
+            # ragged entries still concatenate
+            from .reader.device_prefetch import shard_feed_list
+
+            feed = shard_feed_list(feed, self._mesh, self._data_names(),
+                                   program=self._program)
         fetch_list = [f.name if isinstance(f, Variable) else f for f in (fetch_list or [])]
         return self._exe.run(
             self._program,

@@ -1,6 +1,13 @@
 """Reader composition utilities (reference: python/paddle/reader/__init__.py)."""
 from . import creator  # noqa: F401
+from . import device_prefetch  # noqa: F401
 from .creator import np_array, recordio, text_file  # noqa: F401
+from .device_prefetch import (  # noqa: F401
+    DevicePrefetcher,
+    decorate_device_feed,
+    device_feed_reader,
+    put_feed_on_device,
+)
 from .decorator import (  # noqa: F401
     buffered,
     cache,

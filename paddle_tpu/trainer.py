@@ -462,9 +462,52 @@ class Trainer:
             "nan_guard: %d consecutive non-finite steps; rewound "
             "parameters/rng to checkpoint serial %d" % (bad_steps, meta["serial"]))
 
+    def _feed_pipeline(self, reader, feeder, program, prefetch,
+                       prefetch_buffer):
+        """Reader -> creator of per-epoch feed-dict generators,
+        ``creator(skip=N)`` dropping the first N batches at the RAW
+        reader (before conversion/transfer — a resume must not pay the
+        input pipeline for already-applied steps).  With prefetch on
+        (default; opt out per call or via
+        ``PADDLE_TPU_DEVICE_PREFETCH=0``), DataFeeder conversion and the
+        host->device transfer run on a background thread into a bounded
+        buffer (reader.device_prefetch), so the step loop consumes
+        already-committed device arrays and the executor fast path does
+        zero host-side feed work.  Training is bitwise-identical either
+        way — the pipeline moves work off the critical path, it never
+        changes the values."""
+        import itertools
+
+        from .reader import device_prefetch
+
+        if prefetch is None:
+            prefetch = device_prefetch.prefetch_enabled_default()
+
+        def creator(skip=0):
+            src = reader if not skip else (
+                lambda: itertools.islice(reader(), skip, None))
+            if prefetch:
+                return device_prefetch.decorate_device_feed(
+                    src, feeder, self.exe, program,
+                    buffer_size=prefetch_buffer)()
+            return (feeder.feed(data) for data in src())
+
+        return creator
+
     def train(self, num_epochs, event_handler=None, reader=None,
-              feed_order=None, nan_guard=False, failure_monitor=None):
+              feed_order=None, nan_guard=False, failure_monitor=None,
+              prefetch=None, prefetch_buffer=2):
         """Run the training loop.
+
+        ``prefetch``: route the reader through the async device-feed
+        pipeline (``reader.device_prefetch``) so batch N+1's conversion
+        and host->device transfer overlap batch N's compute.  ``None``
+        (default) follows ``PADDLE_TPU_DEVICE_PREFETCH`` (on unless set
+        to ``0``); ``False`` opts out for this call.  ``prefetch_buffer``
+        bounds the in-flight batches (2 = double buffer).  The pipeline
+        composes with the fault-tolerance features below: a rewind or a
+        monitor-triggered stop tears the buffer down via the shared
+        shutdown path, and resume/nan_guard semantics are unchanged.
 
         ``nan_guard``: ``True`` (limit 3) or an int N.  Arms the
         executor's on-device step guard: one fused finiteness reduction
@@ -496,64 +539,76 @@ class Trainer:
         self.__stopped = False
         serial = self._serial_start
         global_step = 0
+        feed_creator = self._feed_pipeline(reader, feeder, self.train_program,
+                                           prefetch, prefetch_buffer)
         if failure_monitor is not None:
             failure_monitor.start()
         try:
             with scope_guard(self.scope):
                 for epoch_id in range(self._epoch_start, num_epochs):
                     event_handler(BeginEpochEvent(epoch_id))
-                    for step_id, data in enumerate(reader()):
-                        if epoch_id == self._epoch_start and step_id < self._step_start:
-                            # already applied before the checkpoint this run
-                            # resumed from — replaying would double-count them
-                            continue
-                        if self.__stopped:
-                            return
-                        if failure_monitor is not None and failure_monitor.poll():
-                            # a peer went silent: publish a final checkpoint
-                            # and stop cleanly instead of training into a
-                            # dead cluster ("step" = this un-executed step,
-                            # so a resume replays it)
+                    # steps already applied before the checkpoint this run
+                    # resumed from are dropped at the raw reader (replaying
+                    # would double-count them; converting/transferring them
+                    # just to discard would stall the resume)
+                    skip = (self._step_start
+                            if epoch_id == self._epoch_start else 0)
+                    feeds = feed_creator(skip)
+                    try:
+                        for step_id, feed in enumerate(feeds, start=skip):
+                            if self.__stopped:
+                                return
+                            if failure_monitor is not None and failure_monitor.poll():
+                                # a peer went silent: publish a final checkpoint
+                                # and stop cleanly instead of training into a
+                                # dead cluster ("step" = this un-executed step,
+                                # so a resume replays it)
+                                cfg = self.checkpoint_cfg
+                                if cfg:
+                                    serial += 1
+                                    save_checkpoint(
+                                        self.exe, cfg.checkpoint_dir,
+                                        self.train_program, serial,
+                                        {"epoch": epoch_id, "step": step_id},
+                                        cfg.max_num_checkpoints)
+                                self.stop()
+                                return
+                            begin = BeginStepEvent(epoch_id, step_id)
+                            event_handler(begin)
+                            fetch = self.train_func_outputs if begin.fetch_metrics else []
+                            metrics = self.exe.run(
+                                self.train_program, feed=feed,
+                                fetch_list=fetch,
+                                use_program_cache=self.use_program_cache,
+                                nan_guard=bool(guard_n),
+                            )
+                            if guard_n:
+                                if self.exe.last_step_ok() is False:
+                                    self.nan_bad_steps += 1
+                                    consecutive_bad += 1
+                                    if consecutive_bad >= guard_n:
+                                        self._rewind_to_checkpoint(consecutive_bad)
+                                        consecutive_bad = 0
+                                else:
+                                    consecutive_bad = 0
+                            event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                            global_step += 1
                             cfg = self.checkpoint_cfg
-                            if cfg:
+                            if cfg and global_step % cfg.step_interval == 0:
                                 serial += 1
                                 save_checkpoint(
-                                    self.exe, cfg.checkpoint_dir,
-                                    self.train_program, serial,
-                                    {"epoch": epoch_id, "step": step_id},
-                                    cfg.max_num_checkpoints)
-                            self.stop()
-                            return
-                        begin = BeginStepEvent(epoch_id, step_id)
-                        event_handler(begin)
-                        fetch = self.train_func_outputs if begin.fetch_metrics else []
-                        metrics = self.exe.run(
-                            self.train_program, feed=feeder.feed(data),
-                            fetch_list=fetch,
-                            use_program_cache=self.use_program_cache,
-                            nan_guard=bool(guard_n),
-                        )
-                        if guard_n:
-                            if self.exe.last_step_ok() is False:
-                                self.nan_bad_steps += 1
-                                consecutive_bad += 1
-                                if consecutive_bad >= guard_n:
-                                    self._rewind_to_checkpoint(consecutive_bad)
-                                    consecutive_bad = 0
-                            else:
-                                consecutive_bad = 0
-                        event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                        global_step += 1
-                        cfg = self.checkpoint_cfg
-                        if cfg and global_step % cfg.step_interval == 0:
-                            serial += 1
-                            save_checkpoint(
-                                self.exe, cfg.checkpoint_dir, self.train_program, serial,
-                                # "step" counts *completed* steps this epoch, so a
-                                # resume skips exactly [0, step) and the epoch-end
-                                # checkpoint's step=0 means "skip nothing"
-                                {"epoch": epoch_id, "step": step_id + 1}, cfg.max_num_checkpoints,
-                            )
+                                    self.exe, cfg.checkpoint_dir, self.train_program, serial,
+                                    # "step" counts *completed* steps this epoch, so a
+                                    # resume skips exactly [0, step) and the epoch-end
+                                    # checkpoint's step=0 means "skip nothing"
+                                    {"epoch": epoch_id, "step": step_id + 1}, cfg.max_num_checkpoints,
+                                )
+                    finally:
+                        # early return/exception (stop(), failure monitor,
+                        # rewind raise) must tear down in-flight prefetch
+                        close = getattr(feeds, "close", None)
+                        if close is not None:
+                            close()
                     event_handler(EndEpochEvent(epoch_id))
                     cfg = self.checkpoint_cfg
                     if cfg and (epoch_id + 1) % cfg.epoch_interval == 0:
@@ -566,7 +621,7 @@ class Trainer:
             if failure_monitor is not None:
                 failure_monitor.stop()
 
-    def test(self, reader, feed_order):
+    def test(self, reader, feed_order, prefetch=None, prefetch_buffer=2):
         feeder = DataFeeder(
             feed_list=[self.test_program.global_block().var(n) for n in feed_order],
             place=self.place,
@@ -574,17 +629,24 @@ class Trainer:
         )
         accumulated = None
         count = 0
-        with scope_guard(self.scope):
-            for data in reader():
-                # the eval step mutates no state, so the fast path's bound
-                # entry dispatches it with zero state outputs — the hot
-                # shape for Executor fast-path dispatch
-                outs = self.exe.run(self.test_program, feed=feeder.feed(data),
-                                    fetch_list=self.train_func_outputs,
-                                    use_program_cache=self.use_program_cache)
-                vals = [float(np.ravel(o)[0]) for o in outs]
-                accumulated = vals if accumulated is None else [a + v for a, v in zip(accumulated, vals)]
-                count += 1
+        feeds = self._feed_pipeline(reader, feeder, self.test_program,
+                                    prefetch, prefetch_buffer)(0)
+        try:
+            with scope_guard(self.scope):
+                for feed in feeds:
+                    # the eval step mutates no state, so the fast path's bound
+                    # entry dispatches it with zero state outputs — the hot
+                    # shape for Executor fast-path dispatch
+                    outs = self.exe.run(self.test_program, feed=feed,
+                                        fetch_list=self.train_func_outputs,
+                                        use_program_cache=self.use_program_cache)
+                    vals = [float(np.ravel(o)[0]) for o in outs]
+                    accumulated = vals if accumulated is None else [a + v for a, v in zip(accumulated, vals)]
+                    count += 1
+        finally:
+            close = getattr(feeds, "close", None)
+            if close is not None:
+                close()
         return [a / max(count, 1) for a in (accumulated or [])]
 
     def save_params(self, param_path):

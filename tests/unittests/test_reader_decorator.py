@@ -140,3 +140,76 @@ def test_multiprocess_reader_interleaves_all_samples():
 
     with pytest.raises(ValueError):
         D.multiprocess_reader([])
+
+
+def _pump_threads():
+    import threading
+
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("paddle-tpu-buffered-pump",
+                                  "paddle-tpu-interleave-pump"))]
+
+
+def _wait_no_pump_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while _pump_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not _pump_threads(), "leaked producer threads: %r" % _pump_threads()
+
+
+def test_buffered_abandoned_early_shuts_down_producer():
+    """A consumer that breaks out of a buffered() stream must not leave
+    the pump thread blocked forever on q.put with the source open."""
+    closed = []
+
+    def endless():
+        try:
+            i = 0
+            while True:
+                yield i
+                i += 1
+        finally:
+            closed.append(True)
+
+    it = D.buffered(lambda: endless(), size=2)()
+    assert next(it) == 0
+    it.close()  # GeneratorExit -> shutdown path
+    _wait_no_pump_threads()
+    assert closed, "underlying reader left open after abandonment"
+
+
+def test_buffered_abandoned_via_exception_shuts_down_producer():
+    import gc
+
+    it = D.buffered(_creator(range(10**6)), size=1)()
+
+    with pytest.raises(RuntimeError):
+        for i in it:
+            if i == 3:
+                raise RuntimeError("consumer died")
+    # an exception leaves the generator suspended; dropping the last ref
+    # triggers GeneratorExit -> the shared shutdown path
+    del it
+    gc.collect()
+    _wait_no_pump_threads()
+
+
+def test_buffered_normal_eof_leaves_no_threads():
+    assert list(D.buffered(_creator(range(10)), size=3)()) == list(range(10))
+    _wait_no_pump_threads()
+
+
+def test_multiprocess_reader_abandoned_early_shuts_down_producers():
+    def endless(base):
+        def r():
+            i = base
+            while True:
+                yield i
+                i += 1
+        return r
+
+    it = D.multiprocess_reader([endless(0), endless(1000)], queue_size=4)()
+    for _ in range(5):
+        next(it)
+    it.close()
+    _wait_no_pump_threads()

@@ -45,7 +45,7 @@ def main():
         sys.stderr.write(proc.stdout)
         sys.stderr.write("\nbench_dispatch.py produced no JSON report\n")
         return 1
-    missing = [k for k in ("tiny_eval", "tiny_train", "realistic")
+    missing = [k for k in ("tiny_eval", "tiny_train", "realistic", "prefetch")
                if k not in report]
     if missing:
         sys.stderr.write("report missing regimes: %s\n%s\n"
@@ -54,7 +54,11 @@ def main():
     print("dispatch bench smoke OK: " + ", ".join(
         "%s %.0f steps/s (%.2fx)" % (
             k, report[k]["fast_steps_per_s"], report[k]["speedup"])
-        for k in ("tiny_eval", "tiny_train", "realistic")))
+        for k in ("tiny_eval", "tiny_train", "realistic"))
+        + ", prefetch %.0f->%.0f steps/s (%.2fx overlap)" % (
+            report["prefetch"]["sync_steps_per_s"],
+            report["prefetch"]["async_steps_per_s"],
+            report["prefetch"]["overlap_speedup"]))
     return 0
 
 
