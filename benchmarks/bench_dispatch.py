@@ -20,6 +20,11 @@ persistable-state collection through the scope owner chain, per-var
 write-back resolution, and eager (blocking) fetch conversion.  "ON" replays
 a bound-program entry and hands fetches back lazily.
 
+A fifth regime, ``telemetry``, meters the observability subsystem: the
+realistic regime with the JSONL step-record sink attached vs detached,
+smoke-gated at <2% steps/s overhead (records on) and doubling as the
+disabled-path check (records off = one gated attribute read per step).
+
 A fourth regime, ``prefetch``, meters the async device-feed pipeline
 (reader.device_prefetch): a reader whose per-batch host cost ~= one step
 of compute, run sync (reader -> feed -> run in one thread) vs async
@@ -283,6 +288,100 @@ def run_prefetch_regime(iters, reps, smoke):
     return out
 
 
+def run_telemetry_regime(iters, reps, smoke):
+    """Step-record overhead: JSONL telemetry sink on the realistic regime.
+
+    The budget is <2% steps/s with the sink attached.  On this CI class
+    (2 shared cores) an end-to-end A/B at 2% sits below the machine's
+    noise floor — identical legs vary tens of percent run to run — so
+    the smoke-gated number is ANALYTIC and deterministic: the per-record
+    cost through the real hot path (``Executor._emit_step`` → record
+    build → json → buffered write, measured with the sink attached, N
+    records) divided by the calibrated steady-state step time.  The
+    end-to-end rate with the sink attached is still run and reported
+    (records must flow; bitwise neutrality is separately gated by
+    tools/check_observability.py), it just isn't the 2% arbiter."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+
+    model = build_model(4, 256, "adam")
+    batch = 32
+    feed = _feed(batch, 256)
+    fetch_list = [model["loss"]]
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    td = tempfile.mkdtemp()
+    sink = obs.JsonlSink(os.path.join(td, "telemetry.jsonl"))
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(model["startup"])
+            for _ in range(8):  # compile + bind before any timing
+                out = exe.run(model["main"], feed=feed, fetch_list=fetch_list)
+            np.asarray(out[0])
+            # steady-state step time, sink detached: best of `reps` chunks
+            # (best-of tolerates one noisy chunk; it biases the budget
+            # CONSERVATIVELY — a faster step makes the ratio stricter)
+            step_t = float("inf")
+            for _ in range(max(reps, 3)):
+                np.asarray(exe.run(model["main"], feed=feed,
+                                   fetch_list=fetch_list)[0])
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = exe.run(model["main"], feed=feed,
+                                  fetch_list=fetch_list)
+                np.asarray(out[0])
+                step_t = min(step_t, (time.perf_counter() - t0) / iters)
+
+            # per-record cost through the REAL emit path, sink attached
+            obs.add_sink(sink)
+            try:
+                n = 2000
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    _t = time.perf_counter()  # the hot path's two reads
+                    exe._emit_step(model["main"],
+                                   time.perf_counter() - _t, step_t,
+                                   fast_path=True, compiled=False,
+                                   nan_guard=False)
+                record_t = (time.perf_counter() - t0) / n
+
+                # end-to-end with the sink attached (reported, not the
+                # 2% arbiter — see docstring)
+                on_t = float("inf")
+                for _ in range(max(reps, 3)):
+                    np.asarray(exe.run(model["main"], feed=feed,
+                                       fetch_list=fetch_list)[0])
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = exe.run(model["main"], feed=feed,
+                                      fetch_list=fetch_list)
+                    np.asarray(out[0])
+                    on_t = min(on_t, (time.perf_counter() - t0) / iters)
+            finally:
+                obs.remove_sink(sink)
+        emitted = sink.emitted
+    finally:
+        sink.close()
+        shutil.rmtree(td, ignore_errors=True)
+    out = {
+        "plain_steps_per_s": round(1.0 / step_t, 1),
+        "telemetry_steps_per_s": round(1.0 / on_t, 1),
+        "record_cost_us": round(record_t * 1e6, 2),
+        "overhead_pct": round(100.0 * record_t / step_t, 2),
+        "records_emitted": emitted,
+    }
+    if smoke:
+        assert emitted > n, "telemetry leg emitted no step records"
+        assert out["overhead_pct"] < 2.0, (
+            "JSONL step telemetry costs %.2f%% of a realistic step "
+            "(budget 2%%): %.2fus per record on a %.0fus step"
+            % (out["overhead_pct"], record_t * 1e6, step_t * 1e6))
+    return out
+
+
 def check_fast_path_semantics():
     """Smoke assertions: the fast path must be semantically invisible and
     actually engaged (a bound entry exists and hands back lazy fetches)."""
@@ -372,6 +471,9 @@ def main(argv=None):
             iters = max(30, iters // 10)
         results[name] = run_regime(name, cfg, batch, iters, reps)
     results["prefetch"] = run_prefetch_regime(
+        iters=args.iters or (30 if args.smoke else 100), reps=reps,
+        smoke=args.smoke)
+    results["telemetry"] = run_telemetry_regime(
         iters=args.iters or (30 if args.smoke else 100), reps=reps,
         smoke=args.smoke)
     print(json.dumps(results, indent=2, sort_keys=True))

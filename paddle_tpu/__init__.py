@@ -36,6 +36,7 @@ from . import transpiler
 from . import parallel
 from . import contrib
 from . import debugger
+from . import observability
 from . import resilience
 from . import trainer as trainer_mod
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent, save_checkpoint, load_checkpoint, FailureMonitor)
@@ -116,6 +117,7 @@ __all__ = [
     "Inferencer",
     "CheckpointConfig",
     "FailureMonitor",
+    "observability",
     "resilience",
     "recordio_writer",
     "contrib",

@@ -46,6 +46,7 @@ import weakref
 import numpy as np
 
 from . import core
+from . import observability as _obs
 from . import profiler as _prof
 from . import resilience
 from .framework import (
@@ -236,7 +237,8 @@ class LazyFetch:
 
     def materialize(self):
         if self._np is None:
-            self._np = np.asarray(self._device_value)
+            with _obs.span("executor.fetch_materialize"):
+                self._np = np.asarray(self._device_value)
             self._device_value = None
         return self._np
 
@@ -354,18 +356,25 @@ def _scope_chain_token(scope):
 _BOUND_MISS = object()  # sentinel: bound validation failed, take slow path
 
 # Host-side feed conversions (asarray/astype passes over feed values)
-# performed by the executor, across all instances.  The on-device feed
-# fast path's contract is that committed device feeds never touch this
-# counter — tests assert a zero delta (ISSUE 3 acceptance).
-_feed_host_copies = [0]
+# performed by the executor, across all instances — a telemetry-registry
+# counter so step records report it without a second source of truth.
+# The on-device feed fast path's contract is that committed device feeds
+# never touch this counter — tests assert a zero delta (ISSUE 3
+# acceptance).  Counters always count (observability.registry), so the
+# value is identical with telemetry on or off.
+_feed_copies = _obs.counter("executor.feed_host_copy")
+# the async feed pipeline's transfer counter, read here for step records
+# (same registry cell reader.device_prefetch increments)
+_prefetch_transfers = _obs.counter("prefetch.transfer")
 
 
 def feed_host_copy_count():
     """Process-wide count of host-side feed conversions the executor has
     performed.  Feeding committed jax arrays (reader.device_prefetch)
     must leave it unchanged — the instrumentation behind the zero-copy
-    assertion in tests/unittests/test_device_prefetch.py."""
-    return _feed_host_copies[0]
+    assertion in tests/unittests/test_device_prefetch.py.  A view of the
+    ``executor.feed_host_copy`` telemetry counter."""
+    return _feed_copies.value
 
 
 def enable_compilation_cache(cache_dir=None):
@@ -937,6 +946,12 @@ class Executor:
         self.place = place if place is not None else TPUPlace()
         self._cache: dict = {}
         self._bound: dict = {}
+        # step telemetry: records flow only when the global registry is
+        # enabled AND a sink is attached (telemetry.recording — one
+        # attribute read per run otherwise)
+        self._telemetry = _obs.get_telemetry()
+        self._run_id = "exe-%08x" % (id(self) & 0xFFFFFFFF)
+        self._run_seq = 0
         # device-side result of the last nan_guard finiteness check; None
         # when the last run had no guard (see last_step_ok)
         self._last_guard_flag = None
@@ -997,6 +1012,12 @@ class Executor:
         feed = feed or {}
         nan_guard = bool(nan_guard)
 
+        # step-record gate: one attribute read; when no sink is attached
+        # (or PADDLE_TPU_TELEMETRY=0) the whole telemetry path below is
+        # two cheap boolean checks
+        recording = self._telemetry.recording
+        t_run0 = time.perf_counter() if recording else 0.0
+
         fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
 
         # fast path: a prior run of this (program, scope, fetch list) bound
@@ -1007,7 +1028,8 @@ class Executor:
             bound_key = (id(program), id(scope), tuple(fetch_names), nan_guard)
             bound = self._bound.get(bound_key)
             if type(bound) is _BoundProgram:
-                out = self._run_bound(bound, program, scope, feed, return_numpy)
+                out = self._run_bound(bound, program, scope, feed,
+                                      return_numpy, recording, t_run0)
                 if out is not _BOUND_MISS:
                     # LRU touch: keep concurrently hot bindings resident
                     del self._bound[bound_key]
@@ -1063,7 +1085,8 @@ class Executor:
             clients = self._pserver_clients(program)
             return pserver_runtime.run_trainer_step(self, program, feed, fetch_list, scope, clients)
 
-        feed_arrays = self._prepare_feed(program, feed)
+        with self._telemetry.span("executor.prepare_feed"):
+            feed_arrays = self._prepare_feed(program, feed)
         if resilience._feed_fault is not None:  # fault-injection harness
             feed_arrays = resilience._feed_fault(feed_arrays)
         state_in = self._collect_state(program, scope)
@@ -1080,11 +1103,13 @@ class Executor:
         )
         entry = self._cache.get(sig) if use_program_cache else None
         call_entry = entry
+        compiled_fresh = False
         if entry is not None:
             # LRU touch: re-inserting keeps hot entries at the young end
             del self._cache[sig]
             self._cache[sig] = entry
         if entry is None:
+            compiled_fresh = True
             entry = self._build(program, sorted(feed_arrays), fetch_names,
                                 sorted(state_in), nan_guard=nan_guard)
             if use_program_cache:
@@ -1094,13 +1119,24 @@ class Executor:
             # first call compiles: retry transient XLA setup failures
             call_entry = lambda *a: _retry_fresh_entry(entry, *a)  # noqa: E731
 
+        execute_s = None
         if _prof.is_profiling():
             import jax
 
             t0 = time.perf_counter()
             fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
             jax.block_until_ready(fetches)
-            _prof.record("executor.run[prog@%x v%d]" % (id(program), program.version), time.perf_counter() - t0)
+            execute_s = time.perf_counter() - t0
+            _prof.record("executor.run[prog@%x v%d]" % (id(program), program.version), execute_s)
+        elif recording or self._telemetry.span_active():
+            # span-only sinks (a trace with no record sink) must still
+            # see the dispatch/compile spans, not just the other sites'
+            t0 = time.perf_counter()
+            with self._telemetry.span(
+                    "executor.compile" if compiled_fresh
+                    else "executor.dispatch"):
+                fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
+            execute_s = time.perf_counter() - t0
         else:
             fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
         if nan_guard and getattr(entry, "_guard_cell", {}).get("emits"):
@@ -1124,6 +1160,10 @@ class Executor:
             self._bind(bound_key, program, scope, feed, feed_arrays,
                        state_in, new_state, wb_owners, key_owner, entry,
                        fetch_names, reader_fed, nan_guard)
+        if recording:
+            self._emit_step(program, time.perf_counter() - t_run0,
+                            execute_s, fast_path=False,
+                            compiled=compiled_fresh, nan_guard=nan_guard)
         # slow path converts eagerly — exactly the pre-fast-path contract
         return self._finalize_fetches(fetches, return_numpy, lazy=False,
                                       eager_idx=())
@@ -1138,6 +1178,33 @@ class Executor:
         if flag is None:
             return None
         return bool(np.asarray(flag))
+
+    def _emit_step(self, program, duration_s, execute_s, fast_path,
+                   compiled, nan_guard):
+        """One structured step record to the telemetry sinks (caller gates
+        on ``self._telemetry.recording``).  ``nan_ok`` is None here by
+        design: materializing the on-device verdict would force a host
+        sync per step — Trainer records carry the real verdict because
+        the guard loop reads it anyway (see observability.STEP_SCHEMA)."""
+        seq = self._run_seq
+        self._run_seq = seq + 1
+        self._telemetry.emit({
+            "type": "step",
+            "ts": time.time(),
+            "source": "executor",
+            "run_id": self._run_id,
+            "program": "%x:v%d" % (id(program), getattr(program, "version", 0)),
+            "step": seq,
+            "duration_s": duration_s,
+            "steps_per_s": (1.0 / duration_s) if duration_s > 0 else None,
+            "feed_host_copies": _feed_copies.value,
+            "prefetch_transfers": _prefetch_transfers.value,
+            "nan_ok": None,
+            "nan_guard": nan_guard,
+            "fast_path": fast_path,
+            "compile": compiled,
+            "execute_s": execute_s,
+        })
 
     def _finalize_fetches(self, fetches, return_numpy, lazy, eager_idx):
         if return_numpy:
@@ -1256,12 +1323,15 @@ class Executor:
         self._bound.pop(bound_key, None)  # re-insert at the young end
         self._bound[bound_key] = b
 
-    def _run_bound(self, bound, program, scope, feed, return_numpy):
+    def _run_bound(self, bound, program, scope, feed, return_numpy,
+                   recording=False, t_run0=0.0):
         """One step through the bound fast path; returns _BOUND_MISS when
         any precondition drifted (program edited, scope mutated or died,
         feed shape/dtype changed, state var gone) — caller evicts the
         entry and falls back to the slow path, which re-derives everything
-        and rebinds."""
+        and rebinds.  ``recording``/``t_run0`` come from run()'s entry so
+        a fast-path step record reports the same dispatch-side wall
+        duration the slow path does."""
         if bound.version != program.version or bound.nan_debug != _NAN_DEBUG["on"]:
             return _BOUND_MISS
         if bound.scope() is not scope:  # dead ref, or id() reuse after GC
@@ -1294,7 +1364,7 @@ class Executor:
                 # from copying); device array: cast stays on device
                 if isinstance(val, (np.ndarray, np.generic)):
                     val = val.astype(p[2], copy=False)
-                    _feed_host_copies[0] += 1
+                    _feed_copies.inc()
                 else:
                     val = val.astype(p[2])
             feed_arrays[name] = val
@@ -1317,7 +1387,14 @@ class Executor:
         if resilience._feed_fault is not None:  # fault-injection harness
             feed_arrays = resilience._feed_fault(feed_arrays)
         self._last_guard_flag = None  # never report a previous run's verdict
-        fetches, new_state, new_key = bound.entry(state_in, feed_arrays, key)
+        if recording or self._telemetry.span_active():
+            t0 = time.perf_counter()
+            with self._telemetry.span("executor.dispatch"):
+                fetches, new_state, new_key = bound.entry(
+                    state_in, feed_arrays, key)
+            execute_s = time.perf_counter() - t0
+        else:
+            fetches, new_state, new_key = bound.entry(state_in, feed_arrays, key)
         if bound.guard:
             self._last_guard_flag = fetches[-1][0]
             fetches = fetches[:-1]
@@ -1336,6 +1413,10 @@ class Executor:
         cell = bound.alias_cell
         if cell is not None and cell.get("idx"):
             eager = eager | cell["idx"]
+        if recording:
+            self._emit_step(bound.program, time.perf_counter() - t_run0,
+                            execute_s, fast_path=True, compiled=False,
+                            nan_guard=bound.guard)
         return self._finalize_fetches(fetches, return_numpy,
                                       lazy=self.lazy_fetches, eager_idx=eager)
 
@@ -1364,14 +1445,14 @@ class Executor:
                 out[name + "@LENGTHS"] = np.asarray(val.lengths)
                 if val.sub_lengths is not None:
                     out[name + "@SUBLENGTHS"] = np.asarray(val.sub_lengths)
-                _feed_host_copies[0] += 1
+                _feed_copies.inc()
             elif isinstance(val, tuple) and len(val) == 2:
                 arr = np.asarray(val[0])
                 if blk.has_var(name):
                     self._check_feed_shape(name, blk.var(name), arr)
                 out[name] = arr
                 out[name + "@LENGTHS"] = np.asarray(val[1], dtype=np.int32)
-                _feed_host_copies[0] += 1
+                _feed_copies.inc()
             elif self._is_device_array(val):
                 # already-on-device feed (reader.device_prefetch, a fetch
                 # fed back in): validate shape by metadata and, if the
@@ -1393,7 +1474,7 @@ class Executor:
                         arr = arr.astype(core.np_dtype(want), copy=False)
                     self._check_feed_shape(name, var, arr)
                 out[name] = arr
-                _feed_host_copies[0] += 1
+                _feed_copies.inc()
         return out
 
     @staticmethod

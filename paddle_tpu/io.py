@@ -14,6 +14,7 @@ from io import BytesIO
 
 import numpy as np
 
+from . import observability as _obs
 from . import resilience
 from .executor import Executor, global_scope
 from .framework import Parameter, Program, Variable, default_main_program
@@ -85,16 +86,17 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
         vars = list(filter(predicate, main_program.list_vars()))
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
-    if filename is None:
-        for v in vars:
-            _write_npy(os.path.join(dirname, v.name + ".npy"), _var_bytes(scope, v.name))
-    else:
-        if not filename.endswith(".npz"):
-            filename += ".npz"  # np.savez appended it; keep the layout
-        _write_npz(
-            os.path.join(dirname, filename),
-            {v.name: _var_bytes(scope, v.name) for v in vars},
-        )
+    with _obs.timed("io.save_vars", vars=len(vars)):
+        if filename is None:
+            for v in vars:
+                _write_npy(os.path.join(dirname, v.name + ".npy"), _var_bytes(scope, v.name))
+        else:
+            if not filename.endswith(".npz"):
+                filename += ".npz"  # np.savez appended it; keep the layout
+            _write_npz(
+                os.path.join(dirname, filename),
+                {v.name: _var_bytes(scope, v.name) for v in vars},
+            )
 
 
 def save_params(executor, dirname, main_program=None, filename=None):

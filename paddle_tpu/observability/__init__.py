@@ -1,0 +1,139 @@
+"""Unified observability: step telemetry, trace events, pluggable sinks.
+
+The reference Fluid shipped ``profiler.py``/``metrics.py`` as first-class
+training instrumentation; its C++ runtime additionally kept global
+counters (paddle/fluid/platform/profiler.cc).  This package is the
+TPU-native rebuild of that idea as ONE subsystem instead of scattered
+module-level counters:
+
+- :class:`Telemetry` — a registry of named counters / gauges / timers
+  with thread-safe updates (the async device-feed pipeline publishes
+  from background threads) and a near-zero-overhead disabled path.
+  Counters and gauges ALWAYS count: they are the single source of truth
+  behind the public accessors (``executor.feed_host_copy_count()``,
+  ``reader.device_prefetch.transfer_count()``), so enabling or disabling
+  telemetry never changes their values — the bitwise on/off contract.
+- step records — ``Executor.run`` and ``Trainer.train``/``test`` emit
+  one structured dict per step (steps/s, compile vs execute time, feed
+  host-copy count, prefetch transfer count, NaN-guard verdict,
+  retry/rewind totals, checkpoint durations) tagged with program/run
+  ids.  Records only flow when telemetry is enabled AND a sink is
+  attached; otherwise the per-step cost is one attribute read.
+- trace spans — host-side phases (feed conversion, device_put,
+  dispatch, fetch materialization, checkpoint IO) recorded as
+  begin/duration events per thread, exportable as Chrome
+  ``trace_event`` JSON (:class:`~.sinks.ChromeTraceSink`) that loads in
+  Perfetto next to ``jax.profiler`` device traces — the overlap the
+  async feed pipeline buys is visually verifiable.
+- pluggable sinks (:mod:`~.sinks`) — JSONL file, in-memory ring buffer
+  for tests, periodic stdout summary, Chrome-trace exporter.
+
+``PADDLE_TPU_TELEMETRY=0`` is the process-wide killswitch: step records,
+spans, and the profiler's implicit stdout report all go quiet; counter
+arithmetic is unaffected.
+
+Usage::
+
+    from paddle_tpu import observability as obs
+
+    sink = obs.JsonlSink("/tmp/telemetry.jsonl")
+    obs.add_sink(sink)
+    trainer.train(...)          # step records stream to the file
+    sink.close()
+
+    trace = obs.ChromeTraceSink("/tmp/trace.json")
+    obs.add_sink(trace)
+    trainer.train(...)          # host spans; load trace.json in Perfetto
+    trace.close()
+"""
+from __future__ import annotations
+
+from .registry import (
+    Counter,
+    Gauge,
+    Telemetry,
+    Timer,
+    add_sink,
+    counter,
+    emit,
+    enabled,
+    gauge,
+    get_telemetry,
+    inc,
+    observe,
+    observe_span,
+    record_span,
+    remove_sink,
+    reset,
+    span,
+    timed,
+    timer,
+)
+from .sinks import (
+    ChromeTraceSink,
+    JsonlSink,
+    RingBufferSink,
+    Sink,
+    StdoutSummarySink,
+    print_report,
+)
+
+__all__ = [
+    "Telemetry",
+    "Counter",
+    "Gauge",
+    "Timer",
+    "get_telemetry",
+    "enabled",
+    "counter",
+    "gauge",
+    "timer",
+    "inc",
+    "observe",
+    "span",
+    "record_span",
+    "timed",
+    "observe_span",
+    "emit",
+    "reset",
+    "add_sink",
+    "remove_sink",
+    "Sink",
+    "JsonlSink",
+    "RingBufferSink",
+    "StdoutSummarySink",
+    "ChromeTraceSink",
+    "print_report",
+    "STEP_SCHEMA",
+]
+
+# The step-record schema every future perf/robustness PR reports into.
+# ``tools/check_observability.py`` validates JSONL sink output against it;
+# keys marked required must be present in every trainer step record.
+STEP_SCHEMA = {
+    "required": [
+        "type",            # "step"
+        "ts",              # wall-clock seconds (time.time)
+        "source",          # "trainer" | "executor"
+        "run_id",          # opaque id tying one loop's records together
+        "program",         # program tag ("<id-hex>:v<version>")
+        "step",            # 0-based step index within the source's run
+        "duration_s",      # wall seconds of this step
+        "steps_per_s",     # 1 / duration_s
+        "feed_host_copies",    # cumulative executor.feed_host_copy counter
+        "prefetch_transfers",  # cumulative prefetch.transfer counter
+        "nan_ok",          # True/False guard verdict, None when unguarded
+    ],
+    "optional": [
+        "phase",           # trainer records: "train" | "test"
+        "epoch",           # trainer records only
+        "compile",         # True when this run built+compiled a fresh entry
+        "fast_path",       # executor records: bound fast path replayed
+        "nan_guard",       # guard armed for this step
+        "retries",         # cumulative resilience.retry counter
+        "rewinds",         # cumulative trainer nan_rewinds
+        "checkpoint_save_s",  # duration, present on checkpoint steps
+        "checkpoint_load_s",  # duration, present after a rewind/resume
+        "metrics",         # fetched scalar metrics when cheaply available
+    ],
+}

@@ -1,0 +1,285 @@
+"""Pluggable telemetry sinks.
+
+A sink receives structured step records (``emit``) and, if it declares
+``wants_spans``, host-side trace spans (``emit_span``).  All sinks are
+thread-safe: the async device-feed pipeline publishes spans from its
+transfer thread(s) while the step loop emits records.
+
+- :class:`JsonlSink` — one JSON object per line, the machine-readable
+  training log (schema: ``observability.STEP_SCHEMA``).
+- :class:`RingBufferSink` — bounded in-memory record/span buffer for
+  tests and interactive inspection.
+- :class:`StdoutSummarySink` — periodic one-line progress summary
+  (steps/s, counters) instead of per-step spam.
+- :class:`ChromeTraceSink` — Chrome ``trace_event`` JSON: host spans laid
+  out per thread, loadable in Perfetto (or chrome://tracing) alongside a
+  ``jax.profiler`` device trace, so feed/compute overlap is visible.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import sys
+import threading
+import time
+
+__all__ = [
+    "Sink",
+    "JsonlSink",
+    "RingBufferSink",
+    "StdoutSummarySink",
+    "ChromeTraceSink",
+    "print_report",
+]
+
+
+def print_report(text, stream=None):
+    """Write a human-readable report to stdout UNLESS telemetry is
+    disabled (``PADDLE_TPU_TELEMETRY=0``) — the quiet path the profiler's
+    implicit ``stop_profiler()`` report goes through, so a pytest run or
+    a batch job can silence it without plumbing a flag."""
+    from .registry import get_telemetry
+
+    if not get_telemetry().enabled:
+        return False
+    (stream or sys.stdout).write(text if text.endswith("\n") else text + "\n")
+    return True
+
+
+class Sink:
+    """Base sink: override ``emit`` (records) and/or ``emit_span``."""
+
+    wants_records = True
+    wants_spans = False
+
+    def emit(self, record):
+        raise NotImplementedError
+
+    def emit_span(self, name, ts, dur, thread, tags):  # pragma: no cover
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class JsonlSink(Sink):
+    """Append one JSON object per record to ``path``.
+
+    Values that are not JSON-native (numpy scalars, device arrays handed
+    in as metrics) are coerced via ``float``/``str`` fallback — a record
+    must never raise out of the training loop.  Writes ride Python's
+    buffered file object; ``flush()``/``close()`` make them durable."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1024 * 64)
+        self.emitted = 0
+
+    @staticmethod
+    def _default(obj):
+        try:
+            return float(obj)
+        except (TypeError, ValueError):
+            return str(obj)
+
+    def emit(self, record):
+        line = json.dumps(record, default=self._default,
+                          separators=(",", ":"))
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self.emitted += 1
+
+    def flush(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                self._f.close()
+                self._f = None
+
+
+class RingBufferSink(Sink):
+    """Keep the newest ``capacity`` records (and spans, when asked) in
+    memory — the test/debug sink."""
+
+    def __init__(self, capacity=4096, record_spans=False):
+        self._records = collections.deque(maxlen=capacity)
+        self._spans = collections.deque(maxlen=capacity)
+        self._record_spans = bool(record_spans)
+        # only a record_spans=True instance opts the hot-path span sites
+        # out of their no-op context; a default sink must not make every
+        # span allocate+timestamp just to be dropped at emit_span
+        self.wants_spans = self._record_spans
+        self._lock = threading.Lock()
+
+    def emit(self, record):
+        with self._lock:
+            self._records.append(record)
+
+    def emit_span(self, name, ts, dur, thread, tags):
+        with self._lock:
+            self._spans.append(
+                {"name": name, "ts": ts, "dur": dur,
+                 "thread": thread.name, "tags": tags})
+
+    @property
+    def records(self):
+        with self._lock:
+            return list(self._records)
+
+    @property
+    def spans(self):
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._records.clear()
+            self._spans.clear()
+
+
+class StdoutSummarySink(Sink):
+    """One summary line every ``interval`` seconds (or every ``every_n``
+    records): mean steps/s over the window plus the newest cumulative
+    counters.  Quiet under ``PADDLE_TPU_TELEMETRY=0`` like everything
+    else (the sink only ever sees records when telemetry is enabled)."""
+
+    def __init__(self, interval=10.0, every_n=None, stream=None):
+        self.interval = float(interval)
+        self.every_n = every_n
+        self.stream = stream or sys.stdout
+        self._lock = threading.Lock()
+        self._window = []
+        self._last_flush = time.time()
+
+    def emit(self, record):
+        if record.get("type") != "step":
+            return
+        with self._lock:
+            self._window.append(record)
+            due = (len(self._window) >= self.every_n if self.every_n
+                   else time.time() - self._last_flush >= self.interval)
+            if due:
+                self._flush_window()
+
+    def _flush_window(self):
+        # caller holds the lock
+        window, self._window = self._window, []
+        self._last_flush = time.time()
+        if not window:
+            return
+        last = window[-1]
+        rates = [r["steps_per_s"] for r in window
+                 if isinstance(r.get("steps_per_s"), (int, float))]
+        mean = sum(rates) / len(rates) if rates else float("nan")
+        parts = [
+            "[telemetry] %s step %s" % (last.get("source", "?"),
+                                        last.get("step", "?")),
+            "%.1f steps/s (n=%d)" % (mean, len(window)),
+            "feed_copies=%s" % last.get("feed_host_copies"),
+            "transfers=%s" % last.get("prefetch_transfers"),
+        ]
+        if last.get("nan_ok") is not None:
+            parts.append("nan_ok=%s" % last["nan_ok"])
+        if last.get("rewinds"):
+            parts.append("rewinds=%s" % last["rewinds"])
+        self.stream.write("  ".join(parts) + "\n")
+
+    def flush(self):
+        with self._lock:
+            self._flush_window()
+
+
+class ChromeTraceSink(Sink):
+    """Collect host spans (and step instants) as Chrome ``trace_event``
+    JSON.  ``close()`` writes ``{"traceEvents": [...]}`` to ``path`` —
+    load it in https://ui.perfetto.dev (or chrome://tracing).
+
+    Each Python thread gets its own trace ``tid`` with a ``thread_name``
+    metadata event, so the device-feed pipeline's conversion/transfer
+    spans (``paddle-tpu-device-prefetch`` threads) sit on separate tracks
+    from the main thread's dispatch/fetch spans — overlap is the gap you
+    can SEE.  Timestamps are microseconds of wall-clock time, the same
+    clock ``jax.profiler`` stamps host events with, so the two traces
+    line up when opened together."""
+
+    wants_spans = True
+
+    def __init__(self, path, pid=0, record_steps=True):
+        self.path = path
+        self.pid = pid
+        self.record_steps = record_steps
+        self._lock = threading.Lock()
+        self._events = []
+        self._tids = {}
+        self._closed = False
+
+    def _tid(self, thread):
+        tid = self._tids.get(thread.ident)
+        if tid is None:
+            tid = self._tids[thread.ident] = len(self._tids) + 1
+            self._events.append({
+                "name": "thread_name", "ph": "M", "pid": self.pid,
+                "tid": tid, "args": {"name": thread.name},
+            })
+        return tid
+
+    def emit_span(self, name, ts, dur, thread, tags):
+        with self._lock:
+            if self._closed:
+                return
+            ev = {
+                "name": name, "ph": "X", "pid": self.pid,
+                "tid": self._tid(thread),
+                "ts": ts * 1e6, "dur": max(dur, 1e-7) * 1e6,
+            }
+            if tags:
+                ev["args"] = {k: str(v) for k, v in tags.items()}
+            self._events.append(ev)
+
+    def emit(self, record):
+        if not self.record_steps or record.get("type") != "step":
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._events.append({
+                "name": "%s step %s" % (record.get("source", "step"),
+                                        record.get("step", "?")),
+                "ph": "i", "s": "t", "pid": self.pid,
+                "tid": self._tid(threading.current_thread()),
+                "ts": record.get("ts", time.time()) * 1e6,
+                "args": {"steps_per_s": record.get("steps_per_s")},
+            })
+
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            events = self._events
+        with open(self.path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import observability as _obs
 from .executor import Executor, global_scope
 from .framework import default_main_program, Variable
 
@@ -169,8 +170,9 @@ class ParallelExecutor:
             # ragged entries still concatenate
             from .reader.device_prefetch import shard_feed_list
 
-            feed = shard_feed_list(feed, self._mesh, self._data_names(),
-                                   program=self._program)
+            with _obs.span("pe.shard_feed_list", n=len(feed)):
+                feed = shard_feed_list(feed, self._mesh, self._data_names(),
+                                       program=self._program)
         fetch_list = [f.name if isinstance(f, Variable) else f for f in (fetch_list or [])]
         return self._exe.run(
             self._program,

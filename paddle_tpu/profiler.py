@@ -5,18 +5,35 @@ Perfetto) and adds a host-side per-run timing report in the spirit of the
 reference's sorted op-time table.  The reference profiled per-op kernel
 launches; under whole-block XLA compilation the unit of interest is the
 compiled step, so the report shows per-(program, shape) executable timings.
+
+The host-side timings live on the :mod:`paddle_tpu.observability`
+registry (namespace ``profiler.``) rather than a module-global dict:
+recording is thread-safe against the async device-feed pipeline's
+background threads, ``reset_profiler`` is an explicit in-place reset of
+just that namespace, ``start_profiler`` begins a clean window (no
+leakage from an earlier session in the same process), and there is
+exactly one timing truth shared with the telemetry subsystem.  The
+implicit report from ``stop_profiler()`` (no ``profile_path``) routes
+through the observability stdout path, so ``PADDLE_TPU_TELEMETRY=0``
+silences it — no more bare ``print`` under pytest or batch jobs.
 """
 from __future__ import annotations
 
 import contextlib
-import os
+import threading
 import time
 from collections import defaultdict
 
+from . import observability as _obs
+
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event", "is_profiling", "record", "profile_program", "compiled_op_report"]
 
-_timings = defaultdict(list)
+# every host-side profiler timing is a registry timer under this prefix;
+# the report and reset touch only this namespace
+TIMING_PREFIX = "profiler."
+
 _active = {"on": False, "dir": None, "t0": None}
+_active_lock = threading.Lock()
 
 
 @contextlib.contextmanager
@@ -27,35 +44,45 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    _timings.clear()
+    """Zero every ``profiler.*`` timer in place (other telemetry — the
+    executor's contract counters, user metrics — is untouched)."""
+    _obs.reset(TIMING_PREFIX)
 
 
 def start_profiler(state="All", trace_dir=None):
-    if _active["on"]:
-        return
-    _active["on"] = True
-    _active["t0"] = time.time()
+    with _active_lock:
+        if _active["on"]:
+            return
+        _active["on"] = True
+        _active["t0"] = time.time()
+        _active["dir"] = trace_dir or None
+    # each session reports its own window: an earlier session's timings
+    # (or a previous test's) must not leak into this report
+    reset_profiler()
     if trace_dir:
         import jax
 
-        _active["dir"] = trace_dir
         jax.profiler.start_trace(trace_dir)
 
 
 def stop_profiler(sorted_key="total", profile_path=None):
-    if not _active["on"]:
-        return
-    if _active["dir"]:
+    with _active_lock:
+        if not _active["on"]:
+            return
+        _active["on"] = False
+        trace_dir, _active["dir"] = _active["dir"], None
+    if trace_dir:
         import jax
 
         jax.profiler.stop_trace()
-    _active["on"] = False
     report = format_report(sorted_key)
     if profile_path:
         with open(profile_path, "w") as f:
             f.write(report)
     else:
-        print(report)
+        # stdout via the observability quiet path: silenced process-wide
+        # by PADDLE_TPU_TELEMETRY=0 (pytest runs, batch jobs)
+        _obs.print_report(report)
 
 
 @contextlib.contextmanager
@@ -69,11 +96,11 @@ def profiler(state="All", sorted_key="total", profile_path=None, trace_dir=None)
 
 @contextlib.contextmanager
 def record_event(name):
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         yield
     finally:
-        _timings[name].append(time.time() - t0)
+        record(name, time.perf_counter() - t0)
 
 
 def is_profiling():
@@ -81,14 +108,17 @@ def is_profiling():
 
 
 def record(name, seconds):
-    _timings[name].append(seconds)
+    _obs.observe(TIMING_PREFIX + name, seconds)
 
 
 def format_report(sorted_key="total"):
     rows = []
-    for name, ts in _timings.items():
-        total = sum(ts)
-        rows.append((name, len(ts), total, total / len(ts), min(ts), max(ts)))
+    for name, tm in _obs.get_telemetry().timers().items():
+        if not name.startswith(TIMING_PREFIX):
+            continue
+        st = tm.stats()
+        if st is not None:
+            rows.append((name[len(TIMING_PREFIX):],) + st)
     keyidx = {"total": 2, "calls": 1, "ave": 3, "min": 4, "max": 5}.get(sorted_key, 2)
     rows.sort(key=lambda r: -r[keyidx])
     lines = ["%-48s %8s %12s %12s %12s %12s" % ("Event", "Calls", "Total(s)", "Avg(s)", "Min(s)", "Max(s)")]

@@ -52,6 +52,7 @@ import weakref
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core import np_dtype
 from .decorator import _STOP, _Failure, _cancellable_put, _shutdown_pump
 
@@ -66,26 +67,29 @@ __all__ = [
 ]
 
 
-_transfers = [0]  # host->device feed transfers issued by this module
-_transfers_lock = threading.Lock()  # += is not atomic; transfer_threads > 1
+# host->device feed transfers issued by this module: a telemetry-registry
+# counter (its internal lock covers transfer_threads > 1), the same cell
+# executor step records report as ``prefetch_transfers``
+_transfers = _obs.counter("prefetch.transfer")
 
 
 def transfer_count():
     """Total ``device_put`` transfers this module has issued — bench/test
     instrumentation for the zero-copy contract (a training loop fed by
-    the prefetcher must transfer each batch exactly once)."""
-    return _transfers[0]
+    the prefetcher must transfer each batch exactly once).  A view of
+    the ``prefetch.transfer`` telemetry counter."""
+    return _transfers.value
 
 
 def _device_put(value, placement):
     from ..core import safe_import_jax
 
     jax = safe_import_jax()
-    with _transfers_lock:
-        _transfers[0] += 1
-    if placement is None:
-        return jax.device_put(value)
-    return jax.device_put(value, placement)
+    _transfers.inc()
+    with _obs.span("prefetch.device_put"):
+        if placement is None:
+            return jax.device_put(value)
+        return jax.device_put(value, placement)
 
 
 def prefetch_enabled_default():
@@ -205,7 +209,11 @@ def _feed_pump(source, transform, src_lock, q, stop):
             except StopIteration:
                 break
             if transform is not None:
-                item = transform(item)
+                # the span makes conversion+transfer visible per-batch on
+                # the producer thread's trace track, so Perfetto shows it
+                # overlapping the main thread's dispatch spans
+                with _obs.span("prefetch.convert_transfer"):
+                    item = transform(item)
             if not _cancellable_put(q, item, stop):
                 return
     except BaseException as e:  # noqa: BLE001 — forwarded, not swallowed

@@ -36,6 +36,7 @@ import time
 __all__ = [
     "RetryPolicy",
     "retry",
+    "retry_count",
     "call_with_retry",
     "is_transient_error",
     "is_transient_io_error",
@@ -137,13 +138,41 @@ class RetryPolicy:
 _DEFAULT_POLICY = RetryPolicy()
 
 
+def _note_retry(exc, attempt, delay):
+    """Every retry lands on the telemetry registry (counter
+    ``resilience.retry``; trainer step records report the cumulative
+    value) and, when a sink is listening, emits a ``retry`` event — the
+    lazy import keeps this module free of load-order coupling."""
+    from . import observability as obs
+
+    obs.inc("resilience.retry")
+    tel = obs.get_telemetry()
+    if tel.recording:
+        tel.emit({
+            "type": "retry",
+            "ts": time.time(),
+            "error": repr(exc)[:200],
+            "attempt": attempt,
+            "delay_s": delay,
+        })
+
+
+def retry_count():
+    """Cumulative retries performed by :func:`call_with_retry` across the
+    process — a view of the ``resilience.retry`` telemetry counter."""
+    from . import observability as obs
+
+    return obs.counter("resilience.retry").value
+
+
 def call_with_retry(fn, *args, policy=None, on_retry=None, **kwargs):
     """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
 
     Non-retryable errors (per ``policy.classify``) re-raise immediately;
     retryable ones sleep the next backoff delay and re-run.  ``on_retry``
     (if given) is called as ``on_retry(exc, attempt, delay)`` before each
-    sleep — the hook used for logging/telemetry.
+    sleep, after the built-in telemetry hook (counter
+    ``resilience.retry`` + a ``retry`` event to any attached sink).
     """
     policy = policy or _DEFAULT_POLICY
     schedule = policy.delays()
@@ -158,6 +187,7 @@ def call_with_retry(fn, *args, policy=None, on_retry=None, **kwargs):
                 delay = next(schedule)
             except StopIteration:
                 raise exc from None
+            _note_retry(exc, attempt, delay)
             if on_retry is not None:
                 on_retry(exc, attempt, delay)
             policy.sleep(delay)

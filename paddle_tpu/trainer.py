@@ -29,6 +29,7 @@ validated, rotating checkpoints with cheap resume):
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import shutil
@@ -41,6 +42,7 @@ from io import BytesIO
 import numpy as np
 
 from . import io as io_mod
+from . import observability as _obs
 from . import resilience
 from . import unique_name
 from .data_feeder import DataFeeder
@@ -197,6 +199,17 @@ def _rotate_checkpoints(dirname, max_num, trusted=None):
             shutil.rmtree(os.path.join(dirname, n), ignore_errors=True)
 
 
+# monotonically increasing run ids tie one train()/test() loop's step
+# records together across sinks
+_run_seq = itertools.count()
+
+# registry counters the trainer's step records report (the same cells the
+# executor / prefetcher / resilience layers increment — one source of truth)
+_feed_copies = _obs.counter("executor.feed_host_copy")
+_transfers = _obs.counter("prefetch.transfer")
+_retries = _obs.counter("resilience.retry")
+
+
 def save_checkpoint(executor, dirname, main_program, serial, meta, max_num=3):
     """Atomically write ``checkpoint_<serial>/`` and rotate old serials.
 
@@ -212,6 +225,7 @@ def save_checkpoint(executor, dirname, main_program, serial, meta, max_num=3):
     byte-exact fault-injection choke point sees whole files; stream to
     disk instead if that ever pinches."""
     serial = int(serial)
+    _wall0, _t0 = time.time(), time.perf_counter()
     scope = global_scope()
     cdir = os.path.join(dirname, "checkpoint_%d" % serial)
     tmp = cdir + ".tmp"
@@ -266,6 +280,9 @@ def save_checkpoint(executor, dirname, main_program, serial, meta, max_num=3):
     os.rename(tmp, cdir)  # the atomic publish
     resilience.fsync_dir(dirname)
     _rotate_checkpoints(dirname, max_num, trusted=serial)
+    # one timing truth for checkpoint IO: the registry timer feeds
+    # format_report-style summaries, the span shows up on the trace
+    _obs.observe_span("checkpoint.save", _wall0, _t0, {"serial": serial})
     return cdir
 
 
@@ -323,6 +340,7 @@ def load_checkpoint(executor, dirname, main_program, serial=None):
     strands a restart.  An explicit ``serial`` that was rotated away
     raises a clear error listing the available serials; an explicit
     corrupt serial raises instead of silently loading something else."""
+    _wall0, _t0 = time.time(), time.perf_counter()
     serials = _serials(dirname)
     if not serials:
         raise IOError("no checkpoints under %r" % dirname)
@@ -352,6 +370,9 @@ def load_checkpoint(executor, dirname, main_program, serial=None):
                 "falling back to an older serial" % (s, dirname, e))
             continue
         meta["serial"] = s
+        # hand-timed (multi-exit candidate loop; the span is only emitted
+        # on success, tagged with the serial that won)
+        _obs.observe_span("checkpoint.load", _wall0, _t0, {"serial": s})
         return meta
     raise IOError("no intact checkpoint under %r; tried newest-first: %s"
                   % (dirname, "; ".join(failures)))
@@ -447,6 +468,40 @@ class Trainer:
     def stop(self):
         self.__stopped = True
 
+    def _program_tag(self, program):
+        return "%x:v%d" % (id(program), getattr(program, "version", 0))
+
+    def _emit_step_record(self, tel, run_id, prog_tag, phase, epoch_id,
+                          step_id, duration_s, verdict, guard,
+                          ckpt_save_s=None, ckpt_load_s=None):
+        """One trainer step record (observability.STEP_SCHEMA).  Unlike
+        executor records, ``nan_ok`` carries the REAL on-device verdict:
+        an armed guard loop reads it every step anyway, so reporting it
+        costs nothing extra."""
+        rec = {
+            "type": "step",
+            "ts": time.time(),
+            "source": "trainer",
+            "phase": phase,
+            "run_id": run_id,
+            "program": prog_tag,
+            "epoch": epoch_id,
+            "step": step_id,
+            "duration_s": duration_s,
+            "steps_per_s": (1.0 / duration_s) if duration_s > 0 else None,
+            "feed_host_copies": _feed_copies.value,
+            "prefetch_transfers": _transfers.value,
+            "nan_ok": verdict,
+            "nan_guard": guard,
+            "retries": _retries.value,
+            "rewinds": self.nan_rewinds,
+        }
+        if ckpt_save_s is not None:
+            rec["checkpoint_save_s"] = ckpt_save_s
+        if ckpt_load_s is not None:
+            rec["checkpoint_load_s"] = ckpt_load_s
+        tel.emit(rec)
+
     def _rewind_to_checkpoint(self, bad_steps):
         """nan_guard hit its consecutive-failure limit: restore params +
         rng from the newest intact checkpoint (caller holds scope_guard)."""
@@ -458,6 +513,16 @@ class Trainer:
                 % bad_steps)
         meta = load_checkpoint(self.exe, cfg.checkpoint_dir, self.train_program)
         self.nan_rewinds += 1
+        _obs.inc("trainer.rewind")
+        tel = _obs.get_telemetry()
+        if tel.recording:
+            tel.emit({
+                "type": "rewind",
+                "ts": time.time(),
+                "bad_steps": bad_steps,
+                "serial": meta["serial"],
+                "rewinds": self.nan_rewinds,
+            })
         warnings.warn(
             "nan_guard: %d consecutive non-finite steps; rewound "
             "parameters/rng to checkpoint serial %d" % (bad_steps, meta["serial"]))
@@ -539,6 +604,9 @@ class Trainer:
         self.__stopped = False
         serial = self._serial_start
         global_step = 0
+        tel = _obs.get_telemetry()
+        run_id = "train-%d" % next(_run_seq)
+        prog_tag = self._program_tag(self.train_program)
         feed_creator = self._feed_pipeline(reader, feeder, self.train_program,
                                            prefetch, prefetch_buffer)
         if failure_monitor is not None:
@@ -573,6 +641,9 @@ class Trainer:
                                         cfg.max_num_checkpoints)
                                 self.stop()
                                 return
+                            recording = tel.recording
+                            t_step0 = (time.perf_counter() if recording
+                                       else 0.0)
                             begin = BeginStepEvent(epoch_id, step_id)
                             event_handler(begin)
                             fetch = self.train_func_outputs if begin.fetch_metrics else []
@@ -582,20 +653,27 @@ class Trainer:
                                 use_program_cache=self.use_program_cache,
                                 nan_guard=bool(guard_n),
                             )
+                            verdict = None
+                            ckpt_load_s = None
                             if guard_n:
-                                if self.exe.last_step_ok() is False:
+                                verdict = self.exe.last_step_ok()
+                                if verdict is False:
                                     self.nan_bad_steps += 1
                                     consecutive_bad += 1
                                     if consecutive_bad >= guard_n:
+                                        _t = time.perf_counter()
                                         self._rewind_to_checkpoint(consecutive_bad)
+                                        ckpt_load_s = time.perf_counter() - _t
                                         consecutive_bad = 0
                                 else:
                                     consecutive_bad = 0
                             event_handler(EndStepEvent(epoch_id, step_id, metrics))
                             global_step += 1
+                            ckpt_save_s = None
                             cfg = self.checkpoint_cfg
                             if cfg and global_step % cfg.step_interval == 0:
                                 serial += 1
+                                _t = time.perf_counter()
                                 save_checkpoint(
                                     self.exe, cfg.checkpoint_dir, self.train_program, serial,
                                     # "step" counts *completed* steps this epoch, so a
@@ -603,6 +681,14 @@ class Trainer:
                                     # checkpoint's step=0 means "skip nothing"
                                     {"epoch": epoch_id, "step": step_id + 1}, cfg.max_num_checkpoints,
                                 )
+                                ckpt_save_s = time.perf_counter() - _t
+                            if recording:
+                                self._emit_step_record(
+                                    tel, run_id, prog_tag, "train",
+                                    epoch_id, step_id,
+                                    time.perf_counter() - t_step0,
+                                    verdict, bool(guard_n),
+                                    ckpt_save_s, ckpt_load_s)
                     finally:
                         # early return/exception (stop(), failure monitor,
                         # rewind raise) must tear down in-flight prefetch
@@ -629,11 +715,16 @@ class Trainer:
         )
         accumulated = None
         count = 0
+        tel = _obs.get_telemetry()
+        run_id = "test-%d" % next(_run_seq)
+        prog_tag = self._program_tag(self.test_program)
         feeds = self._feed_pipeline(reader, feeder, self.test_program,
                                     prefetch, prefetch_buffer)(0)
         try:
             with scope_guard(self.scope):
                 for feed in feeds:
+                    recording = tel.recording
+                    t_step0 = time.perf_counter() if recording else 0.0
                     # the eval step mutates no state, so the fast path's bound
                     # entry dispatches it with zero state outputs — the hot
                     # shape for Executor fast-path dispatch
@@ -643,6 +734,10 @@ class Trainer:
                     vals = [float(np.ravel(o)[0]) for o in outs]
                     accumulated = vals if accumulated is None else [a + v for a, v in zip(accumulated, vals)]
                     count += 1
+                    if recording:
+                        self._emit_step_record(
+                            tel, run_id, prog_tag, "test", 0, count - 1,
+                            time.perf_counter() - t_step0, None, False)
         finally:
             close = getattr(feeds, "close", None)
             if close is not None:

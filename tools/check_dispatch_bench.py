@@ -45,7 +45,8 @@ def main():
         sys.stderr.write(proc.stdout)
         sys.stderr.write("\nbench_dispatch.py produced no JSON report\n")
         return 1
-    missing = [k for k in ("tiny_eval", "tiny_train", "realistic", "prefetch")
+    missing = [k for k in ("tiny_eval", "tiny_train", "realistic", "prefetch",
+                           "telemetry")
                if k not in report]
     if missing:
         sys.stderr.write("report missing regimes: %s\n%s\n"
@@ -58,7 +59,10 @@ def main():
         + ", prefetch %.0f->%.0f steps/s (%.2fx overlap)" % (
             report["prefetch"]["sync_steps_per_s"],
             report["prefetch"]["async_steps_per_s"],
-            report["prefetch"]["overlap_speedup"]))
+            report["prefetch"]["overlap_speedup"])
+        + ", telemetry %.2f%% overhead (%d records)" % (
+            report["telemetry"]["overhead_pct"],
+            report["telemetry"]["records_emitted"]))
     return 0
 
 
