@@ -38,6 +38,7 @@ from . import contrib
 from . import debugger
 from . import observability
 from . import resilience
+from . import serving
 from . import trainer as trainer_mod
 from .trainer import (Trainer, Inferencer, CheckpointConfig, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent, save_checkpoint, load_checkpoint, FailureMonitor)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, InferenceTranspiler, memory_optimize, release_memory
@@ -119,6 +120,7 @@ __all__ = [
     "FailureMonitor",
     "observability",
     "resilience",
+    "serving",
     "recordio_writer",
     "contrib",
     "transpiler",

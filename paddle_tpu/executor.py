@@ -65,7 +65,7 @@ from .registry import get_rule
 logger = logging.getLogger(__name__)
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy",
-           "LazyFetch", "enable_compilation_cache"]
+           "LazyFetch", "enable_compilation_cache", "cache_eviction_count"]
 
 
 # ---------------------------------------------------------------------------
@@ -375,6 +375,34 @@ def feed_host_copy_count():
     assertion in tests/unittests/test_device_prefetch.py.  A view of the
     ``executor.feed_host_copy`` telemetry counter."""
     return _feed_copies.value
+
+
+# LRU evictions from the compiled-entry and bound-program caches.  The
+# caches are bounded (env-tunable, see Executor.__init__) so a caller
+# feeding ever-new shapes — a misconfigured serving batcher skipping its
+# bucket ladder is the canonical case — turns into cache churn visible on
+# the telemetry registry instead of an executable leak that OOMs hours in.
+_cache_evicts = _obs.counter("executor.cache_evict")
+_bound_evicts = _obs.counter("executor.bound_evict")
+
+
+def cache_eviction_count():
+    """(compiled-entry evictions, bound-entry evictions) across the
+    process — views of the ``executor.cache_evict`` /
+    ``executor.bound_evict`` telemetry counters.  A steadily climbing
+    value in steady state means the working set of (program, feed-shape)
+    pairs exceeds the caps: raise PADDLE_TPU_EXECUTOR_CACHE_CAP /
+    PADDLE_TPU_EXECUTOR_BOUND_CACHE_CAP, or fix the feed-shape churn
+    (e.g. a serving batcher padding to its bucket ladder)."""
+    return _cache_evicts.value, _bound_evicts.value
+
+
+def _env_cap(name, default):
+    try:
+        return max(1, int(os.environ.get(name, "") or default))
+    except ValueError:
+        warnings.warn("ignoring non-integer %s=%r" % (name, os.environ[name]))
+        return default
 
 
 def enable_compilation_cache(cache_dir=None):
@@ -933,8 +961,13 @@ def lower_block(ctx: LoweringContext, block: Block):
 class Executor:
     """exe = Executor(TPUPlace()); exe.run(program, feed=..., fetch_list=...)"""
 
+    # Default LRU bounds — generous for training (a handful of programs x
+    # a few feed shapes), and >> any sane serving bucket ladder.  Env-
+    # tunable per process; evictions count on the telemetry registry
+    # (executor.cache_evict / executor.bound_evict), so a shape-churning
+    # workload shows up as a climbing counter, never an executable leak.
     _CACHE_CAP = 64  # compiled (program, shapes) entries kept per executor
-    _BOUND_CAP = 32  # fast-path bound (program, scope, fetches) entries
+    _BOUND_CAP = 64  # fast-path bound (program, scope, fetches, shapes)
 
     def __init__(self, place=None):
         from .core import TPUPlace, safe_import_jax
@@ -946,6 +979,10 @@ class Executor:
         self.place = place if place is not None else TPUPlace()
         self._cache: dict = {}
         self._bound: dict = {}
+        self._cache_cap = _env_cap("PADDLE_TPU_EXECUTOR_CACHE_CAP",
+                                   self._CACHE_CAP)
+        self._bound_cap = _env_cap("PADDLE_TPU_EXECUTOR_BOUND_CACHE_CAP",
+                                   self._BOUND_CAP)
         # step telemetry: records flow only when the global registry is
         # enabled AND a sink is attached (telemetry.recording — one
         # attribute read per run otherwise)
@@ -1025,7 +1062,16 @@ class Executor:
         # on a hit the whole per-step re-derivation below is skipped
         bound_key = None
         if use_program_cache and self.fast_path:
-            bound_key = (id(program), id(scope), tuple(fetch_names), nan_guard)
+            # the key carries each feed's shape so workloads that alternate
+            # among a fixed set of feed shapes — a serving batcher cycling
+            # its bucket ladder — keep one bound entry PER shape instead of
+            # thrashing rebind on every size change; the per-entry plan
+            # still validates dtype/kind before replay.  Sorted so feed
+            # dicts built in different key orders share one entry.
+            bound_key = (id(program), id(scope), tuple(fetch_names),
+                         nan_guard,
+                         tuple(sorted((n, getattr(v, "shape", None))
+                                      for n, v in feed.items())))
             bound = self._bound.get(bound_key)
             if type(bound) is _BoundProgram:
                 out = self._run_bound(bound, program, scope, feed,
@@ -1113,8 +1159,9 @@ class Executor:
             entry = self._build(program, sorted(feed_arrays), fetch_names,
                                 sorted(state_in), nan_guard=nan_guard)
             if use_program_cache:
-                while len(self._cache) >= self._CACHE_CAP:
+                while len(self._cache) >= self._cache_cap:
                     self._cache.pop(next(iter(self._cache)))  # oldest entry
+                    _cache_evicts.inc()
                 self._cache[sig] = entry
             # first call compiles: retry transient XLA setup failures
             call_entry = lambda *a: _retry_fresh_entry(entry, *a)  # noqa: E731
@@ -1318,9 +1365,10 @@ class Executor:
         b.nan_debug = _NAN_DEBUG["on"]
         b.guard = bool(nan_guard
                        and getattr(entry, "_guard_cell", {}).get("emits"))
-        while len(self._bound) >= self._BOUND_CAP:
-            self._bound.pop(next(iter(self._bound)))  # oldest entry
         self._bound.pop(bound_key, None)  # re-insert at the young end
+        while len(self._bound) >= self._bound_cap:
+            self._bound.pop(next(iter(self._bound)))  # oldest entry
+            _bound_evicts.inc()
         self._bound[bound_key] = b
 
     def _run_bound(self, bound, program, scope, feed, return_numpy,

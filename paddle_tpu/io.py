@@ -35,6 +35,7 @@ __all__ = [
     "load_inference_model",
     "load_aot_inference_model",
     "get_inference_program",
+    "read_artifact_bytes",
     "is_parameter",
     "is_persistable",
     "get_parameter_value",
@@ -73,10 +74,25 @@ def _write_npz(path, arrays):
         resilience.fs_write_bytes, path, buf.getvalue(), policy=IO_RETRY_POLICY)
 
 
+def read_artifact_bytes(path):
+    """Read a model-artifact file through the resilience choke point
+    (``fs_read_bytes`` + transient-error retry).  Inference model loads
+    (``__model__``, ``__aot__``, ``__aot_meta__``) share the checkpoint
+    layer's fault-injectable read path, so a flaky model mount retries
+    instead of killing a serving engine's (re)load — and
+    ``testing.faults.flaky_io`` can target exact artifacts in tests."""
+    return resilience.call_with_retry(
+        resilience.fs_read_bytes, path, policy=IO_RETRY_POLICY)
+
+
+def _write_artifact_bytes(path, data):
+    resilience.call_with_retry(
+        resilience.fs_write_bytes, path, data, policy=IO_RETRY_POLICY)
+
+
 def _read_np(path):
     """np.load (npy or npz) through the resilience choke point."""
-    data = resilience.call_with_retry(
-        resilience.fs_read_bytes, path, policy=IO_RETRY_POLICY)
+    data = read_artifact_bytes(path)
     return np.load(BytesIO(data), allow_pickle=False)
 
 
@@ -182,8 +198,9 @@ def save_inference_model(
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name if isinstance(v, Variable) else v for v in target_vars],
     }
-    with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
-        json.dump(model, f)
+    _write_artifact_bytes(
+        os.path.join(dirname, model_filename or "__model__"),
+        json.dumps(model).encode("utf-8"))
     params = [v for v in inference_program.list_vars() if is_persistable(v)]
     save_vars(executor, dirname, vars=params, filename=params_filename)
     if aot:
@@ -228,18 +245,17 @@ def _export_aot(dirname, inference_program, feed_names, fetch_names,
         dtypes.append(np.dtype(dt).name)
     platforms = tuple(platforms or ("cpu", "tpu"))
     exported = jax_export.export(jax.jit(predict), platforms=platforms)(*specs)
-    with open(os.path.join(dirname, "__aot__"), "wb") as f:
-        f.write(exported.serialize())
-    with open(os.path.join(dirname, "__aot_meta__"), "w") as f:
-        json.dump({
-            "feed_names": list(feed_names),
-            "feed_dtypes": dtypes,
-            "feed_shapes": [
-                [str(d) for d in s.shape] for s in specs],
-            "fetch_names": list(fetch_names),
-            "platforms": list(platforms),
-            "jax_version": jax.__version__,
-        }, f)
+    _write_artifact_bytes(os.path.join(dirname, "__aot__"),
+                          bytes(exported.serialize()))
+    _write_artifact_bytes(os.path.join(dirname, "__aot_meta__"), json.dumps({
+        "feed_names": list(feed_names),
+        "feed_dtypes": dtypes,
+        "feed_shapes": [
+            [str(d) for d in s.shape] for s in specs],
+        "fetch_names": list(fetch_names),
+        "platforms": list(platforms),
+        "jax_version": jax.__version__,
+    }).encode("utf-8"))
 
 
 def load_aot_inference_model(dirname):
@@ -254,10 +270,11 @@ def load_aot_inference_model(dirname):
     jax = safe_import_jax()
     from jax import export as jax_export
 
-    with open(os.path.join(dirname, "__aot_meta__")) as f:
-        meta = json.load(f)
-    with open(os.path.join(dirname, "__aot__"), "rb") as f:
-        exported = jax_export.deserialize(bytearray(f.read()))
+    meta = json.loads(
+        read_artifact_bytes(
+            os.path.join(dirname, "__aot_meta__")).decode("utf-8"))
+    exported = jax_export.deserialize(
+        bytearray(read_artifact_bytes(os.path.join(dirname, "__aot__"))))
     call = jax.jit(exported.call)
     feed_names = meta["feed_names"]
     dtypes = [np.dtype(d) for d in meta["feed_dtypes"]]
@@ -270,8 +287,10 @@ def load_aot_inference_model(dirname):
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
-    with open(os.path.join(dirname, model_filename or "__model__")) as f:
-        model = json.load(f)
+    model = json.loads(
+        read_artifact_bytes(
+            os.path.join(dirname, model_filename or "__model__"))
+        .decode("utf-8"))
     program = Program.from_dict(model["program"])
     params = [v for v in program.list_vars() if is_persistable(v)]
     load_vars(executor, dirname, vars=params, filename=params_filename)

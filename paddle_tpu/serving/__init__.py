@@ -1,0 +1,56 @@
+"""Inference serving runtime: dynamic batching over the AOT/fast path.
+
+The reference ships a dedicated deployment stack (the C++ predictor under
+paddle/fluid/inference/api/, the inference transpiler); paddle_tpu's
+equivalent is this package: ``io.save_inference_model`` (optionally
+``aot=True``) produces the artifact, and :class:`InferenceEngine` turns
+it into a server —
+
+    from paddle_tpu import serving
+
+    engine = serving.InferenceEngine("model_dir",
+                                     batch_buckets=(2, 4, 8, 16),
+                                     batch_timeout_ms=2.0)
+    out = engine.predict({"x": x})            # sync, from any thread
+    fut = engine.predict_async({"x": x})      # future with .result()
+    engine.swap_model("model_dir_v2")         # hot swap: load, drain, flip
+    engine.stop()
+
+Adaptive request batching is the big serving-throughput lever on
+accelerators (Clipper NSDI'17, Orca OSDI'22), and on TPU/XLA it
+additionally wants a fixed menu of compiled batch shapes — exactly what
+the executor's bound-program cache and the AOT export already provide:
+the engine warms a bucket ladder of batch sizes once, then every live
+request replays a compiled executable.  Results are bitwise-identical
+to serving each request alone (see ``engine.py`` on the bucket floor),
+backpressure and per-request deadlines fail with typed errors
+(``ServingQueueFull`` / ``ServingTimeout``), model (re)load rides the
+resilience retry choke points, and the whole runtime emits ``serving.*``
+telemetry onto the observability registry (docs/serving.md lists the
+schema).
+"""
+from __future__ import annotations
+
+from .batcher import DynamicBatcher
+from .engine import InferenceEngine
+from .errors import (
+    ServingClosed,
+    ServingError,
+    ServingQueueFull,
+    ServingTimeout,
+)
+from .model_store import LoadedModel, ModelStore
+from .request_queue import Request, RequestQueue
+
+__all__ = [
+    "InferenceEngine",
+    "DynamicBatcher",
+    "ModelStore",
+    "LoadedModel",
+    "Request",
+    "RequestQueue",
+    "ServingError",
+    "ServingTimeout",
+    "ServingQueueFull",
+    "ServingClosed",
+]

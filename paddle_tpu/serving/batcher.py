@@ -1,0 +1,153 @@
+"""Dynamic batcher: the worker thread that coalesces queued requests.
+
+One thread owns dispatch (the executor/AOT executable is replayed from a
+single thread; clients only touch the queue and their request events).
+The loop is the classic adaptive-batching shape (Clipper, NSDI'17):
+
+    head = queue.get()                        # block for the first request
+    window = head ARRIVAL + batch_timeout     # aging in queue counts
+    drain every queued request that fits      # never idle under backlog
+    while rows < max_batch_size and now < window:
+        wait for the next FITTING request     # FIFO; no queue search
+    execute(batch)                            # one padded-bucket dispatch
+
+with ``batch_timeout = 0`` (the default) the loop is EAGER: it takes
+whatever is queued right now and dispatches.  That is throughput-optimal
+in both regimes that matter — under backlog the queue refills while a
+batch executes (so batches stay full without any waiting), and when the
+queue runs empty the arrival rate is below the service rate, where
+waiting buys nothing and only adds latency.  A nonzero timeout is the
+latency/efficiency trade for sparse-but-bursty traffic, and it is
+measured from the HEAD request's arrival: time the head already spent
+queued behind the previous dispatch consumes its window, so a backlogged
+engine still never stalls.  Requests whose deadline expired while queued
+are shed here, at pop time, with a ``ServingTimeout`` — never executed,
+because the client has already stopped listening.
+
+The batcher also maintains the COMPLETION WATERMARK: requests complete
+strictly in admission order (FIFO queue, single worker), so
+``completed_seq`` is monotone and :meth:`wait_for` — "everything
+admitted before seq N is finished" — is what hot swap's drain step
+blocks on.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import observability as _obs
+from .errors import ServingTimeout
+
+__all__ = ["DynamicBatcher"]
+
+_expired = _obs.counter("serving.expired")
+
+
+class DynamicBatcher:
+    """Coalesce requests from ``queue`` and hand batches to ``execute``.
+
+    ``execute(requests)`` (the engine's padded-bucket dispatch) is called
+    with a non-empty list whose total rows <= ``max_batch_size``; any
+    exception it raises fails every request in the batch and the worker
+    keeps serving — a poison request must not take the engine down.
+    """
+
+    def __init__(self, queue, execute, max_batch_size, batch_timeout_s,
+                 name="paddle-tpu-serving-batcher"):
+        self._queue = queue
+        self._execute = execute
+        self.max_batch_size = int(max_batch_size)
+        self.batch_timeout_s = float(batch_timeout_s)
+        self._stop = False
+        self._drain = True
+        self._done_lock = threading.Lock()
+        self._done_cond = threading.Condition(self._done_lock)
+        self.completed_seq = 0
+        self.batches = 0
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()
+
+    # -- drain watermark -----------------------------------------------------
+    def _mark_done(self, requests):
+        with self._done_cond:
+            for r in requests:
+                if r.seq is not None and r.seq > self.completed_seq:
+                    self.completed_seq = r.seq
+            self._done_cond.notify_all()
+
+    def wait_for(self, seq, timeout=None):
+        """Block until every request admitted at or before ``seq`` has
+        completed (answered, failed, or shed).  Returns False on timeout."""
+        with self._done_cond:
+            return self._done_cond.wait_for(
+                lambda: self.completed_seq >= seq, timeout)
+
+    # -- worker --------------------------------------------------------------
+    def _pop_live(self, timeout, max_rows):
+        """Pop the next request that is still worth executing; expired ones
+        are shed (completed with ServingTimeout) without consuming the
+        coalescing window."""
+        while True:
+            req = self._queue.get(timeout=timeout, max_rows=max_rows)
+            if req is None:
+                return None
+            if req.expired():
+                _expired.inc()
+                req.fail(ServingTimeout(
+                    "deadline expired after %.3fs in queue"
+                    % (time.perf_counter() - req.enqueue_ts)))
+                self._mark_done([req])
+                timeout = 0.0  # the wait already happened; just drain heads
+                continue
+            return req
+
+    def _run(self):
+        while True:
+            head = self._pop_live(timeout=0.05, max_rows=None)
+            if head is None:
+                if self._stop and (not self._drain
+                                   or self._queue.depth() == 0):
+                    return
+                continue
+            batch = [head]
+            rows = head.rows
+            window_end = head.enqueue_ts + self.batch_timeout_s
+            while rows < self.max_batch_size:
+                remaining = window_end - time.perf_counter()
+                if remaining <= 0 and self._queue.depth() == 0:
+                    break
+                nxt = self._pop_live(timeout=max(0.0, remaining),
+                                     max_rows=self.max_batch_size - rows)
+                if nxt is None:
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            now = time.perf_counter()
+            for r in batch:
+                r.dispatch_ts = now
+            try:
+                self._execute(batch)
+            except BaseException as exc:  # noqa: BLE001 - worker must survive
+                for r in batch:
+                    if not r.done():
+                        r.fail(exc)
+            self._mark_done(batch)
+            self.batches += 1
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the worker.  ``drain=True`` finishes everything already
+        queued first (the queue must be closed so no new work arrives);
+        ``drain=False`` exits after the in-flight batch."""
+        self._drain = bool(drain)
+        self._stop = True
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        return not self._thread.is_alive()

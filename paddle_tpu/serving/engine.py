@@ -1,0 +1,415 @@
+"""InferenceEngine: dynamic-batching model server over the fast path.
+
+The deployment story the reference covers with its C++ predictor +
+inference transpiler (paddle/fluid/inference/api/) rebuilt TPU-natively:
+load a saved inference model (AOT jax.export artifact or Program), warm
+a fixed ladder of batch-size buckets so every live request replays an
+already-compiled executable, and serve ``predict()``/``predict_async()``
+through a bounded queue + dynamic batcher — many concurrent batch-1
+clients ride one accelerator dispatch.
+
+Bucket discipline is the TPU/XLA-shaped part: an accelerator wants a
+small menu of compiled shapes, not one executable per observed batch
+size.  Every batch is padded (edge-replicating the last row) to the
+smallest covering bucket, and per-request slices come back out
+bitwise-identical to serving each request alone — rows are computed
+independently of their batch neighbors, position, and padding.  The
+default ladder starts at 2, not 1: XLA's CPU backend lowers a
+single-row matmul to a gemv kernel whose accumulation is not bitwise
+consistent with the gemm rows used at every larger bucket, so a floor
+of 2 is what makes "batched == unbatched, bitwise" hold on the menu.
+Pass ``batch_buckets`` including 1 if minimum latency matters more than
+batch-invariance.
+
+Integration contracts (the PR-2/3/4 subsystems, not duplicated):
+model (re)load rides ``io``'s resilience-routed, fault-injectable
+artifact reads; hot swap (:meth:`swap_model`) loads+warms the new
+version while the old serves, drains everything admitted before the
+swap, then flips; health/readiness is a state machine
+(``loading -> ready <-> swapping -> stopped``); and the whole runtime
+reports as first-class ``serving.*`` telemetry — queue-depth gauge,
+batch-size bucket counters, queue-wait/execute timers, and per-request
+spans in the Chrome trace.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import observability as _obs
+from .batcher import DynamicBatcher
+from .errors import ServingClosed, ServingError
+from .model_store import ModelStore
+from .request_queue import Request, RequestQueue
+
+__all__ = ["InferenceEngine"]
+
+_requests = _obs.counter("serving.requests")
+_batches = _obs.counter("serving.batches")
+_batched_rows = _obs.counter("serving.batched_rows")
+_padded_rows = _obs.counter("serving.padded_rows")
+_swaps = _obs.counter("serving.swaps")
+_queue_wait = _obs.timer("serving.queue_wait")
+
+
+class InferenceEngine:
+    """Serve a saved inference model with dynamic request batching.
+
+    Parameters
+    ----------
+    model_dir: directory written by ``io.save_inference_model`` (with or
+        without ``aot=True``).
+    batch_buckets: ladder of precompiled batch sizes; every dispatch is
+        padded to the smallest covering bucket.  Default ``(2, 4, 8, 16)``
+        — see the module docstring for why the floor is 2.
+    max_batch_size: coalescing cap (rows per dispatch); defaults to the
+        largest bucket and must not exceed it.
+    batch_timeout_ms: extra time the batcher may wait, measured from the
+        head request's ARRIVAL, to fill a batch.  The default 0 is eager
+        (dispatch whatever is queued — throughput-optimal under backlog
+        AND under light load, see batcher.py); raise it only to trade
+        latency for fuller batches on sparse-bursty traffic.
+    queue_capacity: bounded admission queue; a full queue raises
+        ``ServingQueueFull`` (backpressure, not blocking).
+    default_deadline_ms: deadline applied to requests that don't carry
+        their own; None = no deadline.
+    backend: "auto" | "aot" | "program" (ModelStore).
+    feed_shapes: ``{name: full_shape}`` overrides for feeds with dynamic
+        non-batch dims (same convention as ``aot_feed_shapes``).
+    warmup: compile the bucket ladder at construction (and at swap).
+    autostart: start the batcher thread immediately; tests pass False to
+        exercise queue semantics deterministically, then call
+        :meth:`start`.
+    """
+
+    def __init__(self, model_dir, batch_buckets=(2, 4, 8, 16),
+                 max_batch_size=None, batch_timeout_ms=0.0,
+                 queue_capacity=128, default_deadline_ms=None, place=None,
+                 backend="auto", feed_shapes=None, warmup=True,
+                 autostart=True):
+        buckets = sorted(set(int(b) for b in batch_buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError("batch_buckets must be positive ints, got %r"
+                             % (batch_buckets,))
+        self.batch_buckets = tuple(buckets)
+        self.max_batch_size = int(max_batch_size or buckets[-1])
+        if self.max_batch_size > buckets[-1]:
+            raise ValueError(
+                "max_batch_size %d exceeds the largest bucket %d — no "
+                "compiled shape could cover a full batch"
+                % (self.max_batch_size, buckets[-1]))
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.default_deadline_ms = default_deadline_ms
+        self._warmup = bool(warmup)
+        self._state = "loading"
+        self._store = ModelStore(place=place, feed_shapes=feed_shapes)
+        self._model_lock = threading.Lock()   # guards the active-model flip
+        self._swap_lock = threading.Lock()    # serializes swap_model calls
+        self._model = self._store.load(model_dir, backend=backend)
+        if self._warmup:
+            self._model.warmup(self.batch_buckets)
+        self._queue = RequestQueue(queue_capacity)
+        self._batcher = DynamicBatcher(
+            self._queue, self._execute_batch, self.max_batch_size,
+            self.batch_timeout_ms / 1e3)
+        self._telemetry = _obs.get_telemetry()
+        # bucket-histogram counter cells resolved once: the dispatch path
+        # must not pay a locked registry lookup + string format per batch
+        self._bucket_counters = {
+            b: _obs.counter("serving.batch_bucket_%d" % b)
+            for b in self.batch_buckets}
+        self._state = "ready"
+        if autostart:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if not self._batcher.alive:
+            self._batcher.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Stop serving.  ``drain=True`` answers everything already queued
+        first; either way, new requests are rejected with
+        ``ServingClosed`` from the moment the stop begins.  An in-flight
+        :meth:`swap_model` finishes first (both serialize on the swap
+        lock) — so stop never races a swap into resurrecting a stopped
+        engine or leaking a half-installed model version."""
+        with self._swap_lock:
+            if self._state == "stopped":
+                return
+            self._state = "stopped"
+            self._queue.close()
+            worker_done = True
+            if self._batcher.alive:
+                worker_done = self._batcher.stop(drain=drain,
+                                                 timeout=timeout)
+            else:
+                drain = False
+            if not drain:
+                self._queue.drain_remaining()
+            # if the join timed out the worker may still be mid-dispatch:
+            # leave the model open (a leak at a forced-shutdown edge)
+            # rather than closing an executable out from under a running
+            # batch
+            if worker_done:
+                self._model.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- health / introspection ----------------------------------------------
+    @property
+    def state(self):
+        """"loading" | "ready" | "swapping" | "stopped"."""
+        return self._state
+
+    def ready(self):
+        """Readiness-probe truth: the engine admits and serves requests
+        ("swapping" still serves — on the outgoing version until the
+        drain completes)."""
+        return self._state in ("ready", "swapping")
+
+    def health(self):
+        return {
+            "state": self._state,
+            "ready": self.ready(),
+            "model_version": self._model.version,
+            "model_dir": self._model.dirname,
+            "backend": self._model.kind,
+            "batch_buckets": list(self.batch_buckets),
+            "max_batch_size": self.max_batch_size,
+            "queue_depth": self._queue.depth(),
+            "queue_capacity": self._queue.capacity,
+            # per-ENGINE totals (the serving.* registry counters are
+            # process-wide and would cross-contaminate co-hosted engines):
+            # admitted = the queue's seq watermark, batches = the worker's
+            # own dispatch count
+            "requests": self._queue.last_seq(),
+            "batches": self._batcher.batches,
+        }
+
+    @property
+    def model_version(self):
+        return self._model.version
+
+    @property
+    def feed_names(self):
+        return list(self._model.feed_names)
+
+    @property
+    def fetch_names(self):
+        return list(self._model.fetch_names)
+
+    # -- request admission ---------------------------------------------------
+    def _normalize_feed(self, feed):
+        model = self._model
+        missing = [n for n in model.feed_names if n not in feed]
+        unknown = [n for n in feed if n not in model.feed_names]
+        if missing or unknown:
+            raise ServingError(
+                "feed names mismatch: missing %s, unknown %s (model feeds "
+                "%s)" % (missing, unknown, model.feed_names))
+        out = {}
+        rows = None
+        for name in model.feed_names:
+            shape, dtype = model.feed_specs[name]
+            arr = np.asarray(feed[name])
+            if arr.dtype != dtype:
+                arr = arr.astype(dtype, copy=False)
+            rest = len(shape) - 1
+            if arr.ndim == rest:         # single sample: add the batch dim
+                arr = arr[None]
+            elif arr.ndim != rest + 1:
+                raise ServingError(
+                    "feed %r has %d dims; expected %d (%s with a leading "
+                    "batch dim) or %d (one sample)"
+                    % (name, arr.ndim, rest + 1, shape, rest))
+            for want, got in zip(shape[1:], arr.shape[1:]):
+                if want is not None and int(want) != int(got):
+                    raise ServingError(
+                        "feed %r has shape %s but the model expects %s "
+                        "(None = batch)" % (name, arr.shape, shape))
+            n = arr.shape[0]
+            if rows is None:
+                rows = n
+            elif n != rows:
+                raise ServingError(
+                    "inconsistent request rows: feed %r has %d, others %d"
+                    % (name, n, rows))
+            out[name] = arr
+        if rows is None or rows < 1:
+            raise ServingError("empty request (zero rows)")
+        if rows > self.max_batch_size:
+            raise ServingError(
+                "request carries %d rows > max_batch_size %d; split it "
+                "client-side" % (rows, self.max_batch_size))
+        return out, rows
+
+    def predict_async(self, feed, deadline_ms=None):
+        """Admit one request; returns its :class:`Request` future
+        (``.result(timeout)`` / ``.done()``).  Raises ``ServingClosed``
+        when stopped, ``ServingQueueFull`` under backpressure, and
+        ``ServingError`` for malformed requests."""
+        if self._state == "stopped":
+            raise ServingClosed("engine is stopped")
+        if self._state == "loading":
+            raise ServingClosed("engine is still loading")
+        arrays, rows = self._normalize_feed(feed)
+        ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
+        deadline = None if ms is None else time.perf_counter() + ms / 1e3
+        req = self._queue.put(Request(arrays, rows, deadline=deadline))
+        _requests.inc()
+        return req
+
+    def predict(self, feed, deadline_ms=None, timeout=None):
+        """Synchronous predict: returns ``[array per fetch]`` for this
+        request's rows (the leading batch dim is preserved; a sample fed
+        without a batch dim still comes back with rows=1 leading)."""
+        return self.predict_async(feed, deadline_ms=deadline_ms).result(
+            timeout=timeout)
+
+    # -- batch execution (batcher thread) ------------------------------------
+    def _bucket_for(self, rows):
+        for b in self.batch_buckets:
+            if b >= rows:
+                return b
+        return self.batch_buckets[-1]
+
+    def _execute_batch(self, requests):
+        with self._model_lock:
+            model = self._model
+        rows = sum(r.rows for r in requests)
+        bucket = self._bucket_for(rows)
+        pad = bucket - rows
+        feed = {}
+        for name in model.feed_names:
+            parts = [r.feed[name] for r in requests]
+            if pad:
+                # edge-replicate the last row: always a valid sample, and
+                # padding never changes other rows' results (rows are
+                # computed independently)
+                parts.append(np.broadcast_to(
+                    parts[-1][-1:], (pad,) + parts[-1].shape[1:]))
+            feed[name] = (parts[0] if len(parts) == 1
+                          else np.concatenate(parts, axis=0))
+        tel = self._telemetry
+        now = time.perf_counter()
+        for r in requests:
+            _queue_wait.observe(now - r.enqueue_ts)
+        with tel.timed("serving.execute", bucket=bucket, rows=rows,
+                       requests=len(requests), version=model.version):
+            outs = model.predict_batch(feed)
+        _batches.inc()
+        _batched_rows.inc(rows)
+        _padded_rows.inc(pad)
+        self._bucket_counters[bucket].inc()
+        offset = 0
+        done_wall = time.time()
+        spans = tel.span_active()
+        # which outputs carry the batch dim: warmup's observed ground
+        # truth when available (a non-batched fetch whose leading dim
+        # coincidentally equals one bucket must NOT be sliced), else the
+        # shape heuristic
+        batched = model.batched_fetch
+        for r in requests:
+            result = []
+            for j, out in enumerate(outs):
+                a = np.asarray(out)
+                is_batched = (a.ndim >= 1 and a.shape[0] == bucket
+                              if batched is None or j >= len(batched)
+                              else batched[j])
+                if is_batched:
+                    # copy: a view would pin the whole batch (and every
+                    # other request's rows) in memory via its base
+                    result.append(np.ascontiguousarray(
+                        a[offset:offset + r.rows]))
+                else:
+                    # batch-dim-less fetch (scalar metric): shared verbatim
+                    result.append(a)
+            offset += r.rows
+            r.complete(result)
+            if spans:
+                tel.record_span(
+                    "serving.request", r.enqueue_wall,
+                    done_wall - r.enqueue_wall,
+                    tags={"rows": r.rows, "bucket": bucket, "seq": r.seq})
+        if tel.recording:
+            tel.emit({
+                "type": "serve_batch", "ts": done_wall,
+                "source": "serving", "bucket": bucket, "rows": rows,
+                "requests": len(requests), "padded": pad,
+                "model_version": model.version,
+                "queue_depth": self._queue.depth(),
+            })
+
+    # -- hot swap ------------------------------------------------------------
+    def swap_model(self, model_dir, backend="auto", drain_timeout_s=60.0):
+        """Hot-swap to the model saved in ``model_dir``: load + warm the
+        new version while the old keeps serving, drain every request
+        admitted before this call, then flip atomically.  Requests
+        admitted DURING the swap may be answered by either version (each
+        answer is a complete output of exactly one version).  Returns
+        the new version number."""
+        if self._state == "stopped":
+            raise ServingClosed("engine is stopped")
+        with self._swap_lock:
+            if self._state == "stopped":  # stop() won the lock first
+                raise ServingClosed("engine is stopped")
+            new = self._store.load(model_dir, backend=backend)
+            # a request normalized against the outgoing model's specs may
+            # execute after the flip: the new model must accept exactly
+            # the same feeds, or in-flight batches could poison on it
+            if (new.feed_names != self._model.feed_names
+                    or new.feed_specs != self._model.feed_specs):
+                new.close()
+                raise ServingError(
+                    "swap rejected: new model feeds %s %s != serving "
+                    "feeds %s %s"
+                    % (new.feed_names, new.feed_specs,
+                       self._model.feed_names, self._model.feed_specs))
+            if self._warmup:
+                new.warmup(self.batch_buckets)
+            prev_state, self._state = self._state, "swapping"
+            try:
+                watermark = self._queue.last_seq()
+                if self._batcher.alive and not self._batcher.wait_for(
+                        watermark, timeout=drain_timeout_s):
+                    raise ServingError(
+                        "drain timed out after %.1fs (watermark seq %d, "
+                        "completed %d)" % (drain_timeout_s, watermark,
+                                           self._batcher.completed_seq))
+            except BaseException:
+                new.close()
+                self._state = prev_state
+                raise
+            with self._model_lock:
+                old, self._model = self._model, new
+            # a batch popped BEFORE the flip may still be executing on
+            # (or about to call) the old model; every such batch only
+            # contains requests admitted before the flip, so draining to
+            # the post-flip watermark guarantees the old version is idle
+            # before it is closed.  If even that drain times out, leave
+            # the old version open (a leak at a pathological edge)
+            # rather than closing an executable under a running batch.
+            old_idle = True
+            if self._batcher.alive:
+                old_idle = self._batcher.wait_for(self._queue.last_seq(),
+                                                  timeout=drain_timeout_s)
+            self._state = "ready"
+        if old_idle:
+            old.close()
+        _swaps.inc()
+        if self._telemetry.recording:
+            self._telemetry.emit({
+                "type": "model_swap", "ts": time.time(), "source": "serving",
+                "from_version": old.version, "to_version": new.version,
+                "model_dir": model_dir,
+            })
+        return new.version

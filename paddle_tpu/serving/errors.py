@@ -1,0 +1,39 @@
+"""Serving error taxonomy.
+
+Every failure a client of :class:`~paddle_tpu.serving.InferenceEngine`
+can see maps to one of these, so callers distinguish "shed this request"
+(``ServingQueueFull`` — retry elsewhere / later), "the request ran out of
+time" (``ServingTimeout`` — its deadline expired in queue or while
+waiting), and "the engine is gone" (``ServingClosed``) without string
+matching.  ``ServingError`` also covers request-shape mistakes (unknown
+feed name, rows over ``max_batch_size``), which are programming errors —
+no retry will fix them.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "ServingError",
+    "ServingTimeout",
+    "ServingQueueFull",
+    "ServingClosed",
+]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-runtime failures (also raised directly for
+    malformed requests: unknown feed names, inconsistent row counts, a
+    request larger than ``max_batch_size``)."""
+
+
+class ServingTimeout(ServingError):
+    """The request's deadline expired — while queued (the batcher sheds it
+    without executing) or while the caller waited on the result."""
+
+
+class ServingQueueFull(ServingError):
+    """Backpressure: the bounded request queue is at capacity.  The
+    request was NOT admitted; shed load or retry after a backoff."""
+
+
+class ServingClosed(ServingError):
+    """The engine is stopped (or stopping) and no longer admits requests."""

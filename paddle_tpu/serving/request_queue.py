@@ -1,0 +1,184 @@
+"""Bounded request queue + the request/future handle.
+
+The admission edge of the serving runtime: ``put`` either admits a
+request (assigning its monotonically increasing ``seq`` — the hot-swap
+drain watermark) or raises :class:`~.errors.ServingQueueFull` /
+:class:`~.errors.ServingClosed` immediately.  No blocking puts: under
+overload the RIGHT behavior for a serving frontend is an instant,
+typed rejection the caller can turn into load shedding, not an
+unbounded line of threads parked inside the engine.
+
+The queue publishes its depth to the ``serving.queue_depth`` gauge on
+every put/pop (gauges always count — reading it never requires a sink),
+and FIFO order is the contract the batcher and the drain watermark both
+lean on: requests complete in admission order, so "everything admitted
+before seq N is done" is one integer comparison.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from .. import observability as _obs
+from .errors import ServingClosed, ServingQueueFull, ServingTimeout
+
+__all__ = ["Request", "RequestQueue"]
+
+_queue_depth = _obs.gauge("serving.queue_depth")
+_queue_full = _obs.counter("serving.queue_full")
+
+
+class Request:
+    """One admitted prediction request; doubles as the caller's future.
+
+    ``feed`` maps feed name -> numpy array with the rows on axis 0;
+    ``rows`` is that leading dim (shared by every feed).  The batcher
+    fills ``_result`` (a list of per-fetch arrays, sliced back out of
+    the batch) or ``_error`` and fires the event; :meth:`result` is the
+    blocking accessor with deadline semantics.
+    """
+
+    __slots__ = ("feed", "rows", "seq", "deadline", "enqueue_wall",
+                 "enqueue_ts", "dispatch_ts", "_event", "_result", "_error")
+
+    def __init__(self, feed, rows, deadline=None):
+        self.feed = feed
+        self.rows = int(rows)
+        self.seq = None              # assigned by RequestQueue.put
+        self.deadline = deadline     # absolute time.perf_counter() instant
+        self.enqueue_wall = None     # wall clock, for trace spans
+        self.enqueue_ts = None       # perf_counter, for queue-wait timing
+        self.dispatch_ts = None
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    # -- batcher side --------------------------------------------------------
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (now if now is not None else time.perf_counter())
+                > self.deadline)
+
+    def complete(self, result):
+        self._result = result
+        self._event.set()
+
+    def fail(self, exc):
+        self._error = exc
+        self._event.set()
+
+    # -- caller side ---------------------------------------------------------
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block until the batcher answers; returns the list of per-fetch
+        arrays for this request's rows.  Raises the request's failure
+        (``ServingTimeout`` when its deadline expired in queue), or
+        ``ServingTimeout`` if ``timeout``/the remaining deadline elapses
+        while waiting — the request itself may still complete later."""
+        wait = timeout
+        if self.deadline is not None:
+            remaining = self.deadline - time.perf_counter()
+            wait = remaining if wait is None else min(wait, remaining)
+        if not self._event.wait(None if wait is None else max(0.0, wait)):
+            raise ServingTimeout(
+                "request (seq %s, %d rows) not answered within %.3fs"
+                % (self.seq, self.rows, wait))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class RequestQueue:
+    """Bounded FIFO of :class:`Request` with typed admission errors."""
+
+    def __init__(self, capacity=128):
+        if capacity < 1:
+            raise ValueError("queue capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._items = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._seq = 0
+        self._closed = False
+        # NOTE: the serving.queue_depth gauge is process-wide (last
+        # writer wins across co-hosted engines) — deliberately NOT reset
+        # here, so constructing a second engine can't zero it while the
+        # first has queued work.  Per-engine depth: RequestQueue.depth()
+        # via engine.health().
+
+    def put(self, request):
+        """Admit ``request`` (assigning its ``seq``) or raise
+        ``ServingQueueFull`` / ``ServingClosed``.  Never blocks."""
+        with self._lock:
+            if self._closed:
+                raise ServingClosed("engine is stopped; request rejected")
+            if len(self._items) >= self.capacity:
+                _queue_full.inc()
+                raise ServingQueueFull(
+                    "request queue at capacity (%d); shed load or retry"
+                    % self.capacity)
+            self._seq += 1
+            request.seq = self._seq
+            request.enqueue_wall = time.time()
+            request.enqueue_ts = time.perf_counter()
+            self._items.append(request)
+            _queue_depth.set(len(self._items))
+            self._not_empty.notify()
+        return request
+
+    def get(self, timeout=None, max_rows=None):
+        """Pop the head request, waiting up to ``timeout`` seconds; None on
+        timeout or when closed-and-empty.  With ``max_rows``, only pops a
+        head that FITS (head.rows <= max_rows) — the batcher's coalesce
+        loop stays FIFO instead of searching the queue for a filler."""
+        with self._lock:
+            if not self._items:
+                if self._closed:
+                    return None
+                self._not_empty.wait(timeout)
+            if not self._items:
+                return None
+            if max_rows is not None and self._items[0].rows > max_rows:
+                return None
+            req = self._items.popleft()
+            _queue_depth.set(len(self._items))
+            return req
+
+    def depth(self):
+        with self._lock:
+            return len(self._items)
+
+    def last_seq(self):
+        """Seq of the newest ADMITTED request — the drain watermark."""
+        with self._lock:
+            return self._seq
+
+    def close(self):
+        """Reject all future puts and wake any blocked getters.  Queued
+        requests stay poppable (the batcher drains them on stop)."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def drain_remaining(self, exc_factory=None):
+        """Pop everything left and fail each request (non-drain shutdown);
+        returns how many were failed."""
+        make = exc_factory or (
+            lambda r: ServingClosed("engine stopped before request ran"))
+        failed = 0
+        while True:
+            with self._lock:
+                if not self._items:
+                    _queue_depth.set(0)
+                    return failed
+                req = self._items.popleft()
+                _queue_depth.set(len(self._items))
+            req.fail(make(req))
+            failed += 1

@@ -1,0 +1,306 @@
+"""Serving runtime unit tests: engine request semantics, bucket padding,
+fault-injected model load, hot swap, executor cache LRU bounds.
+
+The heavier end-to-end behaviors (bitwise batched-vs-unbatched under
+concurrency, deadline/backpressure choreography, swap-under-load,
+telemetry schema, throughput) are gated by tools/check_serving.py via
+test_serving_gate.py; these tests cover the per-component contracts."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.testing import faults
+
+BUCKETS = (2, 4)
+
+
+def _save_model(dirname, seed=17, aot=False, two_fetches=False):
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=4, act="softmax")
+        fetches = [out]
+        if two_fetches:
+            fetches = [out, h]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        np.random.seed(seed)
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], fetches, exe,
+                                      main_program=main, aot=aot)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("serving") / "model")
+    return _save_model(d, aot=True)
+
+
+def test_predict_and_futures(model_dir):
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="program") as eng:
+        X = np.random.RandomState(0).randn(2, 8).astype("float32")
+        (out,) = eng.predict({"x": X})
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(np.sum(out, axis=1), 1.0, rtol=1e-5)
+        fut = eng.predict_async({"x": X})
+        (out2,) = fut.result(timeout=30)
+        assert fut.done()
+        assert out2.tobytes() == out.tobytes()  # deterministic replay
+        # a sample without the batch dim is auto-batched to rows=1
+        (row,) = eng.predict({"x": X[0]})
+        assert row.shape == (1, 4)
+        assert row.tobytes() == np.ascontiguousarray(out[:1]).tobytes()
+
+
+def test_multi_fetch_slicing(model_dir, tmp_path):
+    d = _save_model(str(tmp_path / "m2"), seed=19, two_fetches=True)
+    with serving.InferenceEngine(d, batch_buckets=BUCKETS) as eng:
+        X = np.random.RandomState(1).randn(3, 8).astype("float32")
+        out, hidden = eng.predict({"x": X})
+        assert out.shape == (3, 4) and hidden.shape == (3, 16)
+
+
+def test_malformed_requests_raise(model_dir):
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="program") as eng:
+        X = np.zeros((1, 8), "float32")
+        with pytest.raises(serving.ServingError, match="feed names"):
+            eng.predict({"y": X})
+        with pytest.raises(serving.ServingError, match="max_batch_size"):
+            eng.predict({"x": np.zeros((9, 8), "float32")})
+        with pytest.raises(serving.ServingError, match="expects"):
+            eng.predict({"x": np.zeros((1, 5), "float32")})
+        with pytest.raises(serving.ServingError, match="dims"):
+            eng.predict({"x": np.zeros((1, 1, 1, 8), "float32")})
+        # a good request still works after the bad ones
+        assert eng.predict({"x": X})[0].shape == (1, 4)
+
+
+def test_bucket_padding_counters(model_dir):
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="program") as eng:
+        pad0 = obs.counter("serving.padded_rows").value
+        b3_0 = obs.counter("serving.batch_bucket_4").value
+        X = np.random.RandomState(2).randn(3, 8).astype("float32")
+        (out,) = eng.predict({"x": X})  # 3 rows -> bucket 4, 1 padded row
+        assert out.shape == (3, 4)
+        assert obs.counter("serving.padded_rows").value == pad0 + 1
+        assert obs.counter("serving.batch_bucket_4").value == b3_0 + 1
+
+
+def test_batched_equals_sequential(model_dir):
+    """Concurrent coalesced serving is bitwise-identical to sequential
+    (never-coalesced) serving of the same requests."""
+    rng = np.random.RandomState(3)
+    payloads = [rng.randn(rng.randint(1, 3), 8).astype("float32")
+                for _ in range(12)]
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="program") as eng:
+        want = [eng.predict({"x": p})[0] for p in payloads]  # sequential
+        results = [None] * len(payloads)
+
+        def client(lo, hi):
+            for i in range(lo, hi):
+                results[i] = eng.predict({"x": payloads[i]}, timeout=30)[0]
+
+        threads = [threading.Thread(target=client, args=(t * 3, t * 3 + 3))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i in range(len(payloads)):
+        assert results[i].tobytes() == want[i].tobytes(), i
+
+
+def test_aot_backend_matches_program_backend(model_dir):
+    X = np.random.RandomState(4).randn(2, 8).astype("float32")
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="program") as prog_eng:
+        want = prog_eng.predict({"x": X})[0]
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="aot") as aot_eng:
+        assert aot_eng.health()["backend"] == "aot"
+        got = aot_eng.predict({"x": X})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_model_load_retries_flaky_reads(tmp_path):
+    """Satellite: inference artifact reads ride the resilience choke
+    point — a transiently flaky model mount retries and the load wins."""
+    d = _save_model(str(tmp_path / "m"), seed=23, aot=True)
+    retries0 = obs.counter("resilience.retry").value
+    with faults.flaky_io("__model__", times=2, op="read") as fired:
+        with serving.InferenceEngine(d, batch_buckets=(2,),
+                                     backend="program") as eng:
+            assert eng.ready()
+    assert fired[0] == 2
+    assert obs.counter("resilience.retry").value >= retries0 + 2
+
+    with faults.flaky_io("__aot__", times=1, op="read") as fired:
+        predict, _, _ = fluid.io.load_aot_inference_model(d)
+        X = np.zeros((2, 8), "float32")
+        assert predict({"x": X})[0].shape == (2, 4)
+    assert fired[0] == 1
+
+
+def test_model_load_fails_cleanly_past_retry_budget(tmp_path):
+    """A persistently torn/unreadable artifact exhausts the retry budget
+    and surfaces the injected error instead of hanging or half-loading."""
+    d = _save_model(str(tmp_path / "m"), seed=29)
+    with faults.flaky_io("__model__", times=50, op="read"):
+        with pytest.raises(faults.FaultInjected):
+            serving.ModelStore().load(d, backend="program")
+
+
+def test_hot_swap_idle_engine(tmp_path):
+    d1 = _save_model(str(tmp_path / "v1"), seed=31)
+    d2 = _save_model(str(tmp_path / "v2"), seed=32)
+    X = np.random.RandomState(5).randn(2, 8).astype("float32")
+    with serving.InferenceEngine(d1, batch_buckets=BUCKETS) as eng:
+        v1 = eng.model_version
+        out1 = eng.predict({"x": X})[0]
+        swaps0 = obs.counter("serving.swaps").value
+        v2 = eng.swap_model(d2)
+        assert v2 > v1 and eng.model_version == v2 and eng.ready()
+        assert obs.counter("serving.swaps").value == swaps0 + 1
+        out2 = eng.predict({"x": X})[0]
+        assert out1.tobytes() != out2.tobytes()
+        with serving.InferenceEngine(d2, batch_buckets=BUCKETS) as ref:
+            assert out2.tobytes() == ref.predict({"x": X})[0].tobytes()
+
+
+def test_stop_drains_and_rejects(model_dir):
+    eng = serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                  backend="program", autostart=False)
+    X = np.zeros((1, 8), "float32")
+    futs = [eng.predict_async({"x": X}) for _ in range(3)]
+    eng.start()
+    eng.stop(drain=True)
+    for f in futs:  # queued work was answered before shutdown
+        assert f.result(timeout=5)[0].shape == (1, 4)
+    with pytest.raises(serving.ServingClosed):
+        eng.predict({"x": X})
+    # idempotent
+    eng.stop()
+
+
+def test_no_leaked_serving_threads(model_dir):
+    before = {t.ident for t in threading.enumerate()}
+    eng = serving.InferenceEngine(model_dir, batch_buckets=(2,),
+                                  backend="program")
+    eng.predict({"x": np.zeros((1, 8), "float32")})
+    eng.stop()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.ident not in before and "serving" in t.name]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, "serving threads leaked: %s" % alive
+
+
+def test_warmup_precompiles_buckets(model_dir):
+    """After construction every bucket is compiled+bound: live requests
+    never compile (executor cache stays unchanged while serving)."""
+    with serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                 backend="program") as eng:
+        exe = eng._model._exe
+        compiled = len(exe._cache)
+        assert sorted(eng._model.warmed_buckets) == sorted(BUCKETS)
+        assert compiled >= len(BUCKETS)
+        rng = np.random.RandomState(6)
+        for rows in (1, 2, 3, 4, 2, 1):
+            eng.predict({"x": rng.randn(rows, 8).astype("float32")})
+        assert len(exe._cache) == compiled, "a live request compiled"
+        # one bound fast-path entry per bucket shape
+        from paddle_tpu.executor import _BoundProgram
+
+        bound = [b for b in exe._bound.values()
+                 if isinstance(b, _BoundProgram)]
+        assert len(bound) >= len(BUCKETS)
+
+
+def test_executor_cache_lru_env_caps_and_eviction_counter():
+    """Satellite: bound/compiled caches are LRU-bounded (env-tunable) and
+    evictions land on the telemetry registry."""
+    from paddle_tpu.executor import cache_eviction_count
+
+    os.environ["PADDLE_TPU_EXECUTOR_CACHE_CAP"] = "3"
+    os.environ["PADDLE_TPU_EXECUTOR_BOUND_CACHE_CAP"] = "2"
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        assert exe._cache_cap == 3 and exe._bound_cap == 2
+    finally:
+        del os.environ["PADDLE_TPU_EXECUTOR_CACHE_CAP"]
+        del os.environ["PADDLE_TPU_EXECUTOR_BOUND_CACHE_CAP"]
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(x, size=2)
+    test_prog = main.clone(for_test=True)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        np.random.seed(0)
+        exe.run(startup)
+        e0 = cache_eviction_count()
+        for rows in (1, 2, 3, 4, 5):  # 5 shapes > both caps
+            for _ in range(2):
+                exe.run(test_prog, feed={"x": np.zeros((rows, 4), "f4")},
+                        fetch_list=[out])
+        e1 = cache_eviction_count()
+        assert len(exe._cache) <= 3 and len(exe._bound) <= 2
+        assert e1[0] > e0[0], "compiled-cache eviction not counted"
+        assert e1[1] > e0[1], "bound-cache eviction not counted"
+        # results stay correct through eviction churn
+        got = exe.run(test_prog, feed={"x": np.ones((2, 4), "f4")},
+                      fetch_list=[out])[0]
+        assert np.asarray(got).shape == (2, 2)
+
+
+def test_nonbatched_fetch_with_bucket_sized_lead_dim(tmp_path):
+    """A fetch that does NOT carry the batch dim but whose leading dim
+    equals a bucket size must come back whole, not sliced per request —
+    warmup establishes per-fetch batch-dim ground truth."""
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 53
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        out = fluid.layers.fc(x, size=4, act="softmax",
+                              param_attr=fluid.ParamAttr(name="w_fetch"))
+    w_var = main.global_block().var("w_fetch")  # shape (8, 4): lead == bucket 8
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    d = str(tmp_path / "m")
+    with fluid.scope_guard(scope):
+        np.random.seed(53)
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [out, w_var], exe,
+                                      main_program=main)
+        w_full = np.asarray(scope["w_fetch"]).copy()
+    with serving.InferenceEngine(d, batch_buckets=(2, 8),
+                                 backend="program") as eng:
+        assert eng._model.batched_fetch == [True, False]
+        X = np.random.RandomState(8).randn(5, 8).astype("float32")
+        probs, w_got = eng.predict({"x": X})  # 5 rows -> bucket 8
+        assert probs.shape == (5, 4)
+        assert w_got.shape == (8, 4), "non-batched fetch was sliced"
+        assert w_got.tobytes() == w_full.tobytes()

@@ -1,5 +1,7 @@
 """InferenceTranspiler (BN fold) + memory_optimize parity tests
 (mirrors reference test_inference_model_io / transpiler tests)."""
+import os
+
 import numpy as np
 
 import paddle_tpu as fluid
@@ -43,3 +45,91 @@ def test_memory_optimize_noop():
     out = fluid.memory_optimize(main)
     assert out is main and len(main.global_block().ops) == n_ops
     fluid.release_memory(main)
+
+
+def _conv_bn_model(seed):
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(input=conv)
+        out = fluid.layers.fc(bn, size=5, act="softmax")
+    return main, startup, out
+
+
+def _randomize_bn_stats(scope, rng):
+    for n, v in list(scope.vars.items()):
+        if "batch_norm" in n and ("mean" in n or "variance" in n):
+            arr = np.asarray(v)
+            scope.vars[n] = (np.abs(rng.randn(*arr.shape)) + 0.5).astype(
+                "float32")
+
+
+def test_inference_transpiler_fold_feeds_serving_path(tmp_path):
+    """Satellite: the conv+BN fold composes with save_inference_model and
+    the serving engine — a folded deployment artifact serves outputs
+    allclose to the unfolded program's."""
+    from paddle_tpu import serving
+
+    fluid.unique_name.switch()
+    main, startup, out = _conv_bn_model(seed=43)
+    infer = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 3, 8, 8).astype("float32")
+    with fluid.scope_guard(scope):
+        np.random.seed(43)
+        exe.run(startup)
+        _randomize_bn_stats(scope, rng)
+        (unfolded,) = exe.run(infer, feed={"img": x}, fetch_list=[out])
+        unfolded = np.asarray(unfolded)
+        t = fluid.InferenceTranspiler()
+        t.transpile(infer, scope=scope)
+        assert "batch_norm" not in [op.type for op in
+                                    infer.global_block().ops]
+        d = str(tmp_path / "folded")
+        fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                      main_program=infer)
+    with serving.InferenceEngine(d, batch_buckets=(2, 4),
+                                 backend="program") as eng:
+        (served,) = eng.predict({"img": x})
+    np.testing.assert_allclose(served, unfolded, rtol=1e-4, atol=1e-5)
+
+
+def test_inference_transpiler_fold_composes_with_aot_export(tmp_path):
+    """Satellite: fold -> save_inference_model(aot=True) -> AOT load all
+    compose; the folded AOT artifact predicts allclose to the unfolded
+    program and drops the BN params from the exported model."""
+    fluid.unique_name.switch()
+    main, startup, out = _conv_bn_model(seed=47)
+    infer = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(9)
+    x = rng.randn(4, 3, 8, 8).astype("float32")
+    d = str(tmp_path / "folded_aot")
+    with fluid.scope_guard(scope):
+        np.random.seed(47)
+        exe.run(startup)
+        _randomize_bn_stats(scope, rng)
+        (unfolded,) = exe.run(infer, feed={"img": x}, fetch_list=[out])
+        unfolded = np.asarray(unfolded)
+        fluid.InferenceTranspiler().transpile(infer, scope=scope)
+        fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                      main_program=infer, aot=True)
+    predict, feed_names, _fetch = fluid.io.load_aot_inference_model(d)
+    assert feed_names == ["img"]
+    got = predict({"img": x})[0]
+    np.testing.assert_allclose(got, unfolded, rtol=1e-4, atol=1e-5)
+    # folding removed the BN op, so its scale/shift params must not be
+    # in the exported param set
+    saved = set(os.listdir(d))
+    assert not any("batch_norm" in f and ("scale" in f or "offset" in f)
+                   for f in saved), saved
+    # symbolic batch survives the fold: other batch sizes, same artifact
+    x2 = rng.randn(2, 3, 8, 8).astype("float32")
+    assert predict({"img": x2})[0].shape == (2, 5)
