@@ -1,0 +1,168 @@
+"""Decode-throughput benchmark: continuous batching vs per-sequence serving.
+
+An OPEN-LOOP load (the "millions of users" shape — arrivals don't wait
+for completions): generation requests with mixed prompt lengths arrive on
+a fixed schedule and each decodes ``max_new_tokens`` greedily.  Two legs
+over the SAME decode model and the SAME compiled shapes:
+
+  naive      : ``max_active=1`` — one sequence decodes at a time, the
+               rest wait in the admission queue.  This is request-level
+               scheduling, what a per-sequence serving loop gets.
+  continuous : ``max_active=num_slots`` — iteration-level scheduling
+               (Orca-style): new sequences are admitted into free decode
+               slots *between* steps, so one fixed-shape decode dispatch
+               serves up to ``num_slots`` sequences' next tokens at once
+               over the paged KV cache.
+
+Reported per leg: generated tokens/s, p50/p95 inter-token latency (gaps
+between a sequence's consecutive token timestamps), p50/p95 time to
+first token (enqueue -> first sampled token — the requeue-latency metric
+open-loop load exposes), and the ``executor.compile_count()`` delta
+across the serving window (must be 0: both legs replay warmed
+executables).  Smoke mode (the CI gate via tools/check_decode.py)
+asserts >= 2x tokens/s, bitwise per-sequence token equality between the
+legs, and zero decode-step recompiles after warmup.
+
+CPU-friendly by design: the win is scheduling arithmetic — how many
+sequences' tokens ride one fixed-shape dispatch — the same lever on a
+TPU, where the per-dispatch cost is even more expensive relative to
+per-row compute (chip capture queued via tools/tpu_watchdog2.sh).
+
+Usage:
+  python benchmarks/bench_decode.py            # full run, prints JSON
+  python benchmarks/bench_decode.py --smoke    # quick run + assertions
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+VOCAB = 128
+
+
+def build_model():
+    from paddle_tpu.models import transformer as T
+
+    params, meta = T.lm_params(seed=23, vocab_size=VOCAB, n_layer=2,
+                               n_head=4, d_model=64, d_inner=128,
+                               max_length=256)
+    return T.build_decode_model(params, meta)
+
+
+def make_load(n_requests, interarrival_s, max_new, seed=0):
+    """Mixed-length prompts + an open-loop arrival schedule (uniform
+    spacing with deterministic jitter, so runs are reproducible)."""
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, VOCAB, size=rng.randint(4, 28))
+               .astype(np.int32) for _ in range(n_requests)]
+    jitter = rng.uniform(0.0, interarrival_s * 0.5, size=n_requests)
+    arrivals = np.arange(n_requests) * interarrival_s + jitter
+    return prompts, arrivals, max_new
+
+
+def _pct(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if len(xs) else None
+
+
+def run_leg(model, prompts, arrivals, max_new, max_active, num_slots,
+            page_size, max_seq_len):
+    from paddle_tpu import serving
+    from paddle_tpu.executor import compile_count
+
+    sched = serving.DecodeScheduler(model, serving.DecodeConfig(
+        num_slots=num_slots, max_active=max_active, page_size=page_size,
+        max_seq_len=max_seq_len, max_new_tokens=max_new,
+        queue_capacity=max(256, 2 * len(prompts))))
+    c0 = compile_count()
+    t0 = time.perf_counter()
+    futs = []
+    for p, at in zip(prompts, arrivals):
+        delay = (t0 + at) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)  # open loop: the schedule, not completions
+        futs.append(sched.submit(p, max_new_tokens=max_new))
+    outs = [f.result(timeout=600) for f in futs]
+    elapsed = time.perf_counter() - t0
+    compiles = compile_count() - c0
+    itl, ttft = [], []
+    for f in futs:
+        stamps = f.token_times
+        ttft.append(stamps[0] - f.enqueue_ts)
+        itl.extend(b - a for a, b in zip(stamps, stamps[1:]))
+    n_tokens = sum(len(o) for o in outs)
+    sched.stop()
+    return {
+        "max_active": max_active,
+        "requests": len(prompts),
+        "generated_tokens": n_tokens,
+        "elapsed_s": round(elapsed, 4),
+        "tokens_per_s": round(n_tokens / elapsed, 1),
+        "p50_inter_token_ms": round(_pct(itl, 50) * 1e3, 3),
+        "p95_inter_token_ms": round(_pct(itl, 95) * 1e3, 3),
+        "p50_ttft_ms": round(_pct(ttft, 50) * 1e3, 3),
+        "p95_ttft_ms": round(_pct(ttft, 95) * 1e3, 3),
+        "compiles_during_serve": int(compiles),
+    }, outs
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="small load + assertions (the CI gate)")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--max-new", type=int, default=None)
+    parser.add_argument("--interarrival-ms", type=float, default=None)
+    parser.add_argument("--slots", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    n_req = args.requests or (24 if args.smoke else 64)
+    max_new = args.max_new or (16 if args.smoke else 32)
+    inter = (args.interarrival_ms
+             if args.interarrival_ms is not None
+             else (2.0 if args.smoke else 4.0)) / 1e3
+
+    model = build_model()
+    prompts, arrivals, max_new = make_load(n_req, inter, max_new)
+    legs = {}
+    outs = {}
+    # naive first: its backlog is the worst case, warm jax only once per
+    # leg config (both legs share shapes, so the second leg is pre-warmed
+    # at the jax level but still pays its own scheduler warmup)
+    for name, active in (("naive", 1), ("continuous", args.slots)):
+        legs[name], outs[name] = run_leg(
+            model, prompts, arrivals, max_new, active, args.slots,
+            page_size=16, max_seq_len=256)
+    bitwise = all(a.tobytes() == b.tobytes()
+                  for a, b in zip(outs["naive"], outs["continuous"]))
+    speedup = (legs["continuous"]["tokens_per_s"]
+               / legs["naive"]["tokens_per_s"])
+    report = {"decode": {
+        "workload": {
+            "requests": n_req, "max_new_tokens": max_new,
+            "interarrival_ms": inter * 1e3, "num_slots": args.slots,
+            "vocab": VOCAB, "open_loop": True,
+        },
+        "naive": legs["naive"],
+        "continuous": legs["continuous"],
+        "continuous_batching_speedup": round(speedup, 2),
+        "bitwise_equal": bool(bitwise),
+    }}
+    print(json.dumps(report, indent=2))
+    if args.smoke:
+        assert bitwise, "continuous batching changed some sequence's tokens"
+        assert legs["continuous"]["compiles_during_serve"] == 0, (
+            "decode served with a recompile: %r" % legs["continuous"])
+        assert speedup >= 2.0, (
+            "continuous batching speedup %.2fx < 2x" % speedup)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

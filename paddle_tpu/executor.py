@@ -65,7 +65,8 @@ from .registry import get_rule
 logger = logging.getLogger(__name__)
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy",
-           "LazyFetch", "enable_compilation_cache", "cache_eviction_count"]
+           "LazyFetch", "enable_compilation_cache", "cache_eviction_count",
+           "compile_count", "JitStepCache"]
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +404,66 @@ def _env_cap(name, default):
     except ValueError:
         warnings.warn("ignoring non-integer %s=%r" % (name, os.environ[name]))
         return default
+
+
+# Process-wide count of fresh step compilations: every time a runner has
+# to BUILD an executable — an Executor (program, feed-shape) cache miss,
+# or a JitStepCache key miss — instead of replaying one.  This is the
+# no-recompile assert the serving runtimes lean on: warm the shape menu,
+# snapshot compile_count(), serve, assert the delta is zero (see
+# tools/check_decode.py).
+_compiles = _obs.counter("executor.compile")
+
+
+def compile_count():
+    """Fresh step-executable builds across the process — a view of the
+    ``executor.compile`` telemetry counter.  Replays of cached/bound
+    entries don't count; a nonzero delta across a steady-state serving
+    window means a shape escaped the warmed menu."""
+    return _compiles.value
+
+
+class JitStepCache:
+    """Key-addressed cache of jit-compiled step callables — the
+    bound-program idiom (pre-resolved once, replayed thereafter) for
+    jax-level functions that live OUTSIDE a Program, with the same
+    telemetry contract as the executor's own caches: a key miss counts on
+    ``executor.compile`` (the no-recompile assert), an LRU eviction on
+    ``executor.bound_evict``.
+
+    The decode runtime (serving/decode_scheduler.py) keys its prefill
+    buckets and its one fixed-shape decode step here; because every
+    dispatch goes through :meth:`get`, "zero misses after warmup" is
+    exactly "zero recompiles after warmup".
+    """
+
+    def __init__(self, build, cap=64, name="jit-step"):
+        self._build = build          # key -> compiled/jitted callable
+        self._entries = {}
+        self._cap = int(cap)
+        self.name = name
+
+    def __len__(self):
+        return len(self._entries)
+
+    def keys(self):
+        return list(self._entries)
+
+    def get(self, key):
+        """The callable for ``key``, building (and counting a compile) on
+        first sight; hits are LRU-touched replays."""
+        fn = self._entries.get(key)
+        if fn is not None:
+            del self._entries[key]   # LRU touch: re-insert young
+            self._entries[key] = fn
+            return fn
+        _compiles.inc()
+        fn = self._build(key)
+        while len(self._entries) >= self._cap:
+            self._entries.pop(next(iter(self._entries)))
+            _bound_evicts.inc()
+        self._entries[key] = fn
+        return fn
 
 
 def enable_compilation_cache(cache_dir=None):
@@ -1156,6 +1217,7 @@ class Executor:
             self._cache[sig] = entry
         if entry is None:
             compiled_fresh = True
+            _compiles.inc()
             entry = self._build(program, sorted(feed_arrays), fetch_names,
                                 sorted(state_in), nan_guard=nan_guard)
             if use_program_cache:

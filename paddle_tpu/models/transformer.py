@@ -629,3 +629,186 @@ def get_inference_model(
         )
     return {"infer": infer, "startup": startup, "ids": ids, "scores": scores,
             "feeds": ["src_word"]}
+
+
+# ---------------------------------------------------------------------------
+# Decode-mode forward: a decoder-only LM over the PAGED KV cache.
+#
+# fast_decode above trades FLOPs for compile-once (each While step re-runs
+# the whole padded prefix).  The serving decode runtime
+# (paddle_tpu/serving/decode_scheduler.py) wants the opposite trade: a
+# fixed-shape per-TOKEN step that REUSES cached K/V, with the cache paged
+# so admission/retirement never reshapes anything.  These functions are
+# that forward, written at the jax level (the decode step's whole-loop
+# state — paged pools, page tables, slot arrays — has no Program-level
+# analog): same Transformer anatomy as the graph above (post-norm blocks,
+# scaled embedding + sinusoid positions, bias-free projections), exposed
+# through ``build_decode_model`` as the ``DecodeModel`` pair:
+#
+# * ``lm_prefill``: the padded prompt in one causal pass (flash kernel on
+#   TPU, mha_reference elsewhere), returning per-layer K/V for the
+#   scheduler to scatter into pages + the last real token's logits.
+# * ``lm_decode_step``: one token per slot — project q/k/v, scatter k/v
+#   into each slot's current page/offset, attend over the slot's own
+#   pages (``paged_decode_attention``), finish the block stack, emit
+#   logits.  Row-independent end to end, which is what makes continuous
+#   batching bitwise-equal to per-sequence serving.
+# ---------------------------------------------------------------------------
+
+
+def lm_params(seed=0, vocab_size=256, n_layer=2, n_head=2, d_model=64,
+              d_inner=128, max_length=512):
+    """Initialize decoder-only LM weights (numpy f32) + the static meta
+    dict ``build_decode_model`` needs.  Returns ``(params, meta)`` —
+    ``params`` is a pure array pytree (safe to pass through jit)."""
+    rng = np.random.RandomState(seed)
+
+    def w(rows, cols, scale=None):
+        s = scale if scale is not None else 1.0 / np.sqrt(rows)
+        return (rng.randn(rows, cols) * s).astype(np.float32)
+
+    params = {
+        "tok_emb": (rng.randn(vocab_size, d_model) * 0.02).astype(np.float32),
+        "pos_table": _position_encoding_table(max_length, d_model),
+        "out_w": w(d_model, vocab_size),
+        "layers": [
+            {
+                "wq": w(d_model, d_model), "wk": w(d_model, d_model),
+                "wv": w(d_model, d_model), "wo": w(d_model, d_model),
+                "ln1_s": np.ones(d_model, np.float32),
+                "ln1_b": np.zeros(d_model, np.float32),
+                "ffn_w1": w(d_model, d_inner),
+                "ffn_b1": np.zeros(d_inner, np.float32),
+                "ffn_w2": w(d_inner, d_model),
+                "ffn_b2": np.zeros(d_model, np.float32),
+                "ln2_s": np.ones(d_model, np.float32),
+                "ln2_b": np.zeros(d_model, np.float32),
+            }
+            for _ in range(n_layer)
+        ],
+    }
+    meta = dict(vocab_size=vocab_size, n_layer=n_layer, n_head=n_head,
+                d_model=d_model, d_inner=d_inner, max_length=max_length,
+                head_dim=d_model // n_head)
+    return params, meta
+
+
+def _lm_ln(x, scale, bias, eps=1e-5):
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * scale + bias
+
+
+def _lm_block_tail(lp, x, attn_out):
+    """Post-norm residual tail shared by prefill and decode: attention
+    output projection + LN, then the relu FFN + LN."""
+    import jax.numpy as jnp
+
+    x = _lm_ln(x + attn_out @ lp["wo"], lp["ln1_s"], lp["ln1_b"])
+    h = jnp.maximum(x @ lp["ffn_w1"] + lp["ffn_b1"], 0.0)
+    return _lm_ln(x + h @ lp["ffn_w2"] + lp["ffn_b2"],
+                  lp["ln2_s"], lp["ln2_b"])
+
+
+def lm_prefill(params, tokens, length, *, n_head, use_flash=False):
+    """Causal pass over one padded prompt.  ``tokens``: [T] int32 (pad
+    tail arbitrary), ``length``: real token count.  Returns
+    ``(last_logits [V], k [L, T, H, Dh], v [L, T, H, Dh])`` — k/v in the
+    page-scatter layout, pad-tail rows masked downstream by kv_lens."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.flash_attention import flash_attention, mha_reference
+
+    T = tokens.shape[0]
+    d_model = params["tok_emb"].shape[1]
+    dh = d_model // n_head
+    # jnp views: the tables are numpy at rest, but fancy-indexing by a
+    # traced token array needs jax arrays
+    emb = jnp.asarray(params["tok_emb"])
+    x = emb[tokens] * np.sqrt(d_model) + params["pos_table"][:T]
+    lens1 = jnp.reshape(jnp.asarray(length, jnp.int32), (1,))
+    ks, vs = [], []
+    for lp in params["layers"]:
+        q = (x @ lp["wq"]).reshape(T, n_head, dh)
+        k = (x @ lp["wk"]).reshape(T, n_head, dh)
+        v = (x @ lp["wv"]).reshape(T, n_head, dh)
+        ks.append(k)
+        vs.append(v)
+        q4 = q.transpose(1, 0, 2)[None]  # [1, H, T, Dh]
+        k4 = k.transpose(1, 0, 2)[None]
+        v4 = v.transpose(1, 0, 2)[None]
+        attn = flash_attention if use_flash else mha_reference
+        ctx = attn(q4, k4, v4, causal=True, kv_lens=lens1)
+        ctx = ctx[0].transpose(1, 0, 2).reshape(T, d_model)
+        x = _lm_block_tail(lp, x, ctx)
+    last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=0,
+                                        keepdims=False)
+    return last @ params["out_w"], jnp.stack(ks), jnp.stack(vs)
+
+
+def lm_decode_step(params, tokens, positions, k_pool, v_pool, page_tables,
+                   kv_lens, *, n_head, attn_impl=None):
+    """One decode iteration: token s of each slot at cache index
+    ``positions[s]``.  Writes k/v into the paged pools, attends over each
+    slot's first ``kv_lens[s]`` cached tokens, returns
+    ``(logits [S, V], k_pool', v_pool')``.  ``kv_lens[s] == 0`` =
+    inactive slot (scratch-page write, zero attention, garbage logits
+    the scheduler ignores)."""
+    import jax.numpy as jnp
+
+    from ..parallel.flash_attention import paged_decode_attention
+
+    S = tokens.shape[0]
+    page_size = k_pool.shape[2]
+    d_model = params["tok_emb"].shape[1]
+    dh = d_model // n_head
+    emb = jnp.asarray(params["tok_emb"])
+    pos_table = jnp.asarray(params["pos_table"])
+    x = emb[tokens] * np.sqrt(d_model) + pos_table[positions]
+    pages = page_tables[jnp.arange(S), positions // page_size]
+    offsets = positions % page_size
+    for li, lp in enumerate(params["layers"]):
+        q = (x @ lp["wq"]).reshape(S, n_head, dh)
+        k = (x @ lp["wk"]).reshape(S, n_head, dh)
+        v = (x @ lp["wv"]).reshape(S, n_head, dh)
+        k_pool = k_pool.at[li, pages, offsets].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[li, pages, offsets].set(v.astype(v_pool.dtype))
+        ctx = paged_decode_attention(q, k_pool[li], v_pool[li],
+                                     page_tables, kv_lens, impl=attn_impl)
+        x = _lm_block_tail(lp, x, ctx.reshape(S, d_model))
+    return x @ params["out_w"], k_pool, v_pool
+
+
+def build_decode_model(params, meta, eos_id=None, use_flash=None,
+                       attn_impl=None):
+    """Wrap LM weights as a serving ``DecodeModel``.
+
+    ``use_flash``: prefill attention engine (default: flash on TPU,
+    mha_reference elsewhere); ``attn_impl``: decode paged-attention
+    engine ("auto"/"reference"/"pallas", see paged_decode_attention).
+    """
+    import jax
+
+    from ..serving.decode_scheduler import DecodeModel
+
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    n_head = meta["n_head"]
+
+    def prefill_fn(tokens, length):
+        return lm_prefill(params, tokens, length, n_head=n_head,
+                          use_flash=use_flash)
+
+    def decode_fn(tokens, positions, k_pool, v_pool, page_tables, kv_lens):
+        return lm_decode_step(params, tokens, positions, k_pool, v_pool,
+                              page_tables, kv_lens, n_head=n_head,
+                              attn_impl=attn_impl)
+
+    return DecodeModel(
+        prefill_fn, decode_fn,
+        num_layers=meta["n_layer"], num_heads=n_head,
+        head_dim=meta["head_dim"], vocab_size=meta["vocab_size"],
+        eos_id=eos_id, name="transformer-lm")

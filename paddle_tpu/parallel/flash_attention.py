@@ -36,7 +36,7 @@ import os
 import jax
 import numpy as np
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = ["flash_attention", "mha_reference", "paged_decode_attention"]
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -44,7 +44,13 @@ NEG_INF = -1e30
 
 
 def mha_reference(q, k, v, causal=False, sm_scale=None, kv_lens=None):
-    """Plain XLA attention (for testing / tiny shapes). [B, H, T, D]."""
+    """Plain XLA attention (for testing / tiny shapes). [B, H, T, D].
+
+    Decode contract: a row whose ``kv_lens`` entry is 0 (fully masked —
+    an inactive decode slot) yields ZEROS, matching the flash kernels
+    (whose online softmax accumulates nothing over skipped blocks)
+    instead of the degenerate uniform-mean a plain softmax over an
+    all-masked row would produce."""
     import jax.numpy as jnp
 
     if sm_scale is None:
@@ -58,6 +64,8 @@ def mha_reference(q, k, v, causal=False, sm_scale=None, kv_lens=None):
         mask = jnp.arange(S)[None, :] < kv_lens[:, None]  # [B, S]
         s = jnp.where(mask[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if kv_lens is not None:
+        p = jnp.where(kv_lens[:, None, None, None] > 0, p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -793,3 +801,173 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Decode-shaped attention: single-token queries against a PAGED KV cache.
+#
+# The serving decode runtime (paddle_tpu/serving/decode_scheduler.py) keeps
+# every sequence's keys/values in fixed-size pages of a preallocated pool
+# (vLLM/PagedAttention, Kwon et al. SOSP'23); one decode iteration asks,
+# for each of S slots, "this slot's ONE new query token against its first
+# kv_lens cached tokens".  Two engines:
+#
+# * reference (CPU / tests): gather the slot's pages out of the pool
+#   (``pool[page_tables]``) and run the masked-softmax formulation — the
+#   same arithmetic shape as ``mha_reference`` with T_q=1, so tier-1 stays
+#   green without Pallas interpret overhead.
+# * pallas (TPU): the page table rides the SCALAR-PREFETCH path (the same
+#   ``PrefetchScalarGridSpec`` machinery ``kv_lens`` already uses): the
+#   kernel's k/v BlockSpec index maps read the prefetched table to DMA
+#   exactly this slot's pages — no gathered [S, max_kv, H, D] intermediate
+#   ever exists in HBM.  Online softmax across the slot's page walk, fully
+#   masked pages skipped via ``pl.when``.
+#
+# Contract (shared by both engines, tested in test_flash_decode.py):
+# ``kv_lens[s] == 0`` (inactive slot) yields EXACT ZEROS for that slot.
+# ---------------------------------------------------------------------------
+
+
+def _paged_reference(q, k_pool, v_pool, page_tables, kv_lens, sm_scale):
+    import jax.numpy as jnp
+
+    S, H, Dh = q.shape
+    ps = k_pool.shape[1]
+    mp = page_tables.shape[1]
+    k = k_pool[page_tables].reshape(S, mp * ps, H, Dh).astype(jnp.float32)
+    v = v_pool[page_tables].reshape(S, mp * ps, H, Dh).astype(jnp.float32)
+    s = jnp.einsum("shd,skhd->shk", q.astype(jnp.float32), k) * sm_scale
+    ok = jnp.arange(mp * ps)[None, :] < kv_lens[:, None]  # [S, K]
+    s = jnp.where(ok[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(kv_lens[:, None, None] > 0, p, 0.0)  # inactive slot -> 0
+    return jnp.einsum("shk,skhd->shd", p, v).astype(q.dtype)
+
+
+def _paged_decode_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size, num_pages_per_seq,
+                         sm_scale):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    s_idx = pl.program_id(0)
+    j = pl.program_id(2)  # page walk for this slot (h rides grid dim 1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kvl = lens_ref[s_idx]
+    # pages wholly past the slot's length are skipped: with the page walk
+    # as the LAST grid dim the skip saves the compute, and — unlike the
+    # cross-length fwd kernel — correctness additionally leans on it for
+    # the kv_lens == 0 contract (nothing accumulates; _finish emits 0).
+    visible = j * page_size < kvl
+
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)        # [1, Dh]
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [ps, Dh]
+        v = v_ref[0, :, 0].astype(jnp.float32)  # [ps, Dh]
+        col = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        k = jnp.where(col < kvl, k, 0.0)  # 0*garbage tail rows stay finite
+        v = jnp.where(col < kvl, v, 0.0)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        ok = (j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)) < kvl
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]                      # [1, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, 0:1] * alpha + p.sum(axis=1, keepdims=True), l_scr.shape)
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == num_pages_per_seq - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[0] = (acc_scr[:, :] / denom).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pool, v_pool, page_tables, kv_lens, sm_scale, interpret):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, Dh = q.shape
+    ps = k_pool.shape[1]
+    mp = page_tables.shape[1]
+    # flat [S*mp] so the prefetched table indexes with one scalar read
+    pt_flat = page_tables.astype(jnp.int32).reshape(S * mp)
+    lens = kv_lens.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, page_size=ps, num_pages_per_seq=mp,
+        sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, H, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, Dh), lambda s, h, j, pt, kl: (s, h, 0)),
+            # the slot's j-th PAGE, straight out of the pool: the block
+            # index comes from the prefetched page table
+            pl.BlockSpec((1, ps, 1, Dh),
+                         lambda s, h, j, pt, kl: (pt[s * mp + j], 0, h, 0)),
+            pl.BlockSpec((1, ps, 1, Dh),
+                         lambda s, h, j, pt, kl: (pt[s * mp + j], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Dh), lambda s, h, j, pt, kl: (s, h, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((1, 128), jnp.float32),  # running sum
+            pltpu.VMEM((1, Dh), jnp.float32),   # output accumulator
+        ],
+    )
+    (out,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((S, H, Dh), q.dtype)],
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(pt_flat, lens, q, k_pool, v_pool)
+    return out
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_tables, kv_lens,
+                           sm_scale=None, impl=None, interpret=None):
+    """Single-token-query attention against a paged KV pool.
+
+    q: [S, H, Dh] — one query token per decode slot.
+    k_pool / v_pool: [num_pages, page_size, H, Dh] — ONE layer's pool.
+    page_tables: [S, max_pages] int32 — slot s's kv lives in pages
+        ``page_tables[s, :ceil(kv_lens[s]/page_size)]`` in order; unused
+        entries must point at a valid (scratch) page id.
+    kv_lens: [S] int32 — tokens of valid kv per slot; 0 = inactive slot,
+        whose output row is exactly zero.
+    impl: None/"auto" (pallas on TPU, reference elsewhere), "reference",
+        or "pallas" (tests drive the kernel under interpret=True on CPU).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if impl in (None, "auto"):
+        impl = "reference" if _infer_interpret(q) else "pallas"
+    if impl == "reference":
+        return _paged_reference(q, k_pool, v_pool, page_tables, kv_lens,
+                                sm_scale)
+    if impl != "pallas":
+        raise ValueError("impl must be auto|reference|pallas, got %r" % impl)
+    if interpret is None:
+        interpret = _infer_interpret(q)
+    return _paged_pallas(q, k_pool, v_pool, page_tables, kv_lens, sm_scale,
+                         interpret)

@@ -16,6 +16,14 @@ it into a server —
     engine.swap_model("model_dir_v2")         # hot swap: load, drain, flip
     engine.stop()
 
+Autoregressive generation rides the same engine: construct it with
+``decode_model=`` (see ``models.transformer.build_decode_model``) and
+call ``generate()``/``generate_async()`` — continuous batching
+(iteration-level scheduling, Orca OSDI'22) over a paged KV cache
+(vLLM/PagedAttention SOSP'23), bitwise-equal to per-sequence serving
+with zero decode-step recompiles after warmup (decode_scheduler.py,
+kv_cache.py; docs/serving.md "Autoregressive decode").
+
 Adaptive request batching is the big serving-throughput lever on
 accelerators (Clipper NSDI'17, Orca OSDI'22), and on TPU/XLA it
 additionally wants a fixed menu of compiled batch shapes — exactly what
@@ -32,6 +40,12 @@ schema).
 from __future__ import annotations
 
 from .batcher import DynamicBatcher
+from .decode_scheduler import (
+    DecodeConfig,
+    DecodeModel,
+    DecodeScheduler,
+    GenerateRequest,
+)
 from .engine import InferenceEngine
 from .errors import (
     ServingClosed,
@@ -39,6 +53,7 @@ from .errors import (
     ServingQueueFull,
     ServingTimeout,
 )
+from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
 from .model_store import LoadedModel, ModelStore
 from .request_queue import Request, RequestQueue
 
@@ -49,6 +64,13 @@ __all__ = [
     "LoadedModel",
     "Request",
     "RequestQueue",
+    "DecodeScheduler",
+    "DecodeModel",
+    "DecodeConfig",
+    "GenerateRequest",
+    "PagedKVCache",
+    "write_prompt_kv",
+    "write_token_kv",
     "ServingError",
     "ServingTimeout",
     "ServingQueueFull",

@@ -1,0 +1,580 @@
+"""Continuous-batching decode scheduler: iteration-level sequence serving.
+
+The throughput problem with naive autoregressive serving is REQUEST-level
+scheduling: a batch decodes in lockstep until its *longest* sequence
+finishes, and new arrivals wait for the whole batch to retire — almost
+all of the accelerator's decode capacity burns on padding and requeue
+latency.  This module implements iteration-level scheduling in the style
+of Orca (Yu et al., OSDI'22): the decode step is ONE fixed-shape compiled
+program over ``num_slots`` slots, and the scheduler admits new sequences
+into free slots and retires finished ones *between* iterations — the
+batch composition changes every step, the compiled shape never does.
+
+Shape discipline (the TPU-native part, same philosophy as the predict
+path's bucket ladder):
+
+* **prefill** runs per sequence, padded to a page-multiple LENGTH bucket
+  ladder — one compiled program per bucket, warmed up front.  The
+  prompt's k/v land directly in the sequence's pages
+  (:mod:`~paddle_tpu.serving.kv_cache`).
+* **decode** is a single ``[num_slots]`` program: embed one token per
+  slot, scatter its k/v into the paged pool, attend over each slot's own
+  pages (``paged_decode_attention``), greedy-sample the next token.
+  Inactive slots ride along with ``kv_lens == 0`` — fully masked, exact
+  zeros, scratch-page writes — so admission/retirement never changes the
+  dispatched shape.  Zero recompiles after warmup is asserted against
+  ``executor.compile_count()`` (every dispatch goes through a
+  :class:`~paddle_tpu.executor.JitStepCache`).
+* **bitwise per-sequence equality**: a sequence's tokens depend only on
+  its own slot's row — matmul rows, layer norm, attention-over-own-pages
+  and argmax are all row-independent — so continuous batching returns
+  bit-identical tokens to serving the same request alone
+  (``max_active=1``), which is what tools/check_decode.py gates.
+
+Admission reuses the serving contracts: bounded queue with typed
+``ServingQueueFull`` backpressure, per-request deadlines shed with
+``ServingTimeout`` (in queue AND mid-decode), ``ServingClosed`` after
+stop.  Everything reports as ``serving.decode.*`` telemetry.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import observability as _obs
+from ..executor import JitStepCache
+from .errors import ServingClosed, ServingError, ServingTimeout
+from .kv_cache import PagedKVCache, write_prompt_kv
+from .request_queue import Request, RequestQueue
+
+__all__ = ["DecodeModel", "DecodeConfig", "GenerateRequest",
+           "DecodeScheduler"]
+
+_requests = _obs.counter("serving.decode.requests")
+_tokens = _obs.counter("serving.decode.tokens")
+_prefills = _obs.counter("serving.decode.prefills")
+_steps = _obs.counter("serving.decode.steps")
+_retired = _obs.counter("serving.decode.retired")
+_expired = _obs.counter("serving.decode.expired")
+_queue_full = _obs.counter("serving.decode.queue_full")
+_queue_depth = _obs.gauge("serving.decode.queue_depth")
+_active_slots = _obs.gauge("serving.decode.active_slots")
+_prefill_timer = _obs.timer("serving.decode.prefill_step")
+_decode_timer = _obs.timer("serving.decode.decode_step")
+_queue_wait = _obs.timer("serving.decode.queue_wait")
+
+
+class DecodeModel:
+    """The two pure-jax callables a decode-capable model exposes.
+
+    ``prefill_fn(tokens[T], length) -> (last_logits[V], k[L,T,H,D],
+    v[L,T,H,D])`` — run the whole (padded) prompt; ``length`` is the real
+    token count, ``last_logits`` the logits at position ``length - 1``.
+
+    ``decode_fn(tokens[S], positions[S], k_pool, v_pool,
+    page_tables[S,MP], kv_lens[S]) -> (logits[S,V], k_pool', v_pool')`` —
+    one token per slot: write its k/v at ``positions`` into the paged
+    pools, attend over each slot's first ``kv_lens`` cached tokens.
+    ``kv_lens[s] == 0`` marks an inactive slot (masked, scratch writes).
+
+    Both are jitted by the scheduler (with pool donation on TPU); they
+    must be shape-stable in everything but values.
+    ``models.transformer.build_decode_model`` is the in-repo producer.
+    """
+
+    def __init__(self, prefill_fn, decode_fn, *, num_layers, num_heads,
+                 head_dim, vocab_size, eos_id=None, name="decode-model"):
+        self.prefill_fn = prefill_fn
+        self.decode_fn = decode_fn
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.vocab_size = int(vocab_size)
+        self.eos_id = eos_id
+        self.name = name
+
+
+class DecodeConfig:
+    """Decode-runtime knobs (all shapes derive from these).
+
+    num_slots: decode-step width — concurrent sequences at full load.
+    page_size / max_seq_len: KV paging geometry; ``max_seq_len`` caps
+        ``prompt_len + max_new_tokens`` per sequence.
+    num_pages: pool size (+1 scratch).  Default reserves full worst-case
+        occupancy for every slot — raise/lower to trade HBM for the
+        admission-blocking rate.
+    prefill_buckets: page-multiple prompt-length ladder; default doubles
+        from ``page_size`` up to ``max_seq_len``.
+    max_new_tokens: default per-request generation cap (requests may pass
+        their own, bounded by ``max_seq_len``).
+    max_active: admission cap on concurrently decoding sequences
+        (default ``num_slots``); ``1`` is the naive per-sequence-serving
+        baseline the benchmark compares against.
+    queue_capacity / default_deadline_ms: the PR-5 admission contract.
+    kv_dtype: pool dtype (bf16 on chip halves KV HBM).
+    warmup: compile the decode step + every prefill bucket up front.
+    """
+
+    def __init__(self, num_slots=4, page_size=16, max_seq_len=256,
+                 num_pages=None, prefill_buckets=None, max_new_tokens=64,
+                 max_active=None, queue_capacity=128,
+                 default_deadline_ms=None, kv_dtype="float32", warmup=True):
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_seq_len = int(max_seq_len)
+        self.num_pages = num_pages
+        self.prefill_buckets = prefill_buckets
+        self.max_new_tokens = int(max_new_tokens)
+        self.max_active = (self.num_slots if max_active is None
+                           else int(max_active))
+        self.queue_capacity = int(queue_capacity)
+        self.default_deadline_ms = default_deadline_ms
+        self.kv_dtype = kv_dtype
+        self.warmup = bool(warmup)
+        if self.num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if self.max_active < 1 or self.max_active > self.num_slots:
+            raise ValueError("max_active must be in [1, num_slots]")
+        if self.max_seq_len < self.page_size:
+            raise ValueError("max_seq_len must be >= page_size")
+
+
+class GenerateRequest(Request):
+    """One admitted generation request; doubles as the caller's future.
+
+    ``result(timeout)`` returns the generated token ids as an int32 array
+    (greedy decode; includes the EOS token when one stopped the
+    sequence).  ``token_times`` carries a ``time.perf_counter()`` stamp
+    per generated token — the inter-token-latency record the benchmark
+    reads.
+    """
+
+    __slots__ = ("prompt", "max_new_tokens", "token_times")
+
+    def __init__(self, prompt, max_new_tokens, deadline=None):
+        super().__init__(feed=None, rows=1, deadline=deadline)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.token_times = []
+
+    @property
+    def prompt_len(self):
+        return int(self.prompt.shape[0])
+
+
+class _Slot:
+    """Worker-private state of one active sequence."""
+
+    __slots__ = ("req", "pages", "prompt_len", "kv_len", "generated")
+
+    def __init__(self, req, pages):
+        self.req = req
+        self.pages = pages
+        self.prompt_len = req.prompt_len
+        self.kv_len = req.prompt_len   # tokens written to the paged cache
+        self.generated = []            # sampled tokens (last one not yet fed)
+
+
+class DecodeScheduler:
+    """Continuous-batching generation over a :class:`DecodeModel`.
+
+    One worker thread owns the loop (admit -> decode step -> retire);
+    clients only touch the bounded queue and their request futures —
+    the same single-dispatcher discipline as the predict batcher.
+    """
+
+    def __init__(self, model, config=None, autostart=True):
+        import jax
+
+        self.model = model
+        cfg = self.config = config or DecodeConfig()
+        self._cache = PagedKVCache(
+            model.num_layers,
+            cfg.num_pages or (
+                cfg.num_slots * -(-cfg.max_seq_len // cfg.page_size) + 1),
+            cfg.page_size, model.num_heads, model.head_dim,
+            cfg.max_seq_len, dtype=cfg.kv_dtype)
+        if cfg.prefill_buckets:
+            buckets = sorted(set(int(b) for b in cfg.prefill_buckets))
+            bad = [b for b in buckets
+                   if b % cfg.page_size or b < 1 or b > cfg.max_seq_len]
+            if bad:
+                raise ServingError(
+                    "prefill_buckets must be page_size multiples within "
+                    "max_seq_len; bad: %s" % bad)
+        else:
+            buckets, b = [], cfg.page_size
+            while b < cfg.max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(-(-cfg.max_seq_len // cfg.page_size)
+                           * cfg.page_size)
+            buckets = sorted(set(buckets))
+        self.prefill_buckets = tuple(buckets)
+        self._queue = RequestQueue(cfg.queue_capacity,
+                                   depth_gauge=_queue_depth,
+                                   full_counter=_queue_full)
+        self._telemetry = _obs.get_telemetry()
+        # pool donation saves an HBM copy per step on chip; CPU jax has no
+        # donation and would warn every dispatch
+        donate = (2, 3) if jax.default_backend() == "tpu" else ()
+        self._donated = bool(donate)
+        self._jit = JitStepCache(
+            lambda key: self._build_step(key, donate),
+            cap=len(self.prefill_buckets) + 8, name="decode-steps")
+        self._slots = [None] * cfg.num_slots
+        self._tables = np.zeros(
+            (cfg.num_slots, self._cache.max_pages_per_seq), np.int32)
+        self._hol = None               # head-of-line request awaiting pages
+        self._stop = False
+        self._drain = True
+        self._completed = 0
+        self._thread = threading.Thread(
+            target=self._run, name="paddle-tpu-decode-scheduler", daemon=True)
+        if cfg.warmup:
+            self.warmup()
+        if autostart:
+            self.start()
+
+    # -- compiled steps ------------------------------------------------------
+    def _build_step(self, key, donate):
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        if key[0] == "decode":
+            def decode(tokens, positions, k_pool, v_pool, tables, kv_lens):
+                logits, k_pool, v_pool = model.decode_fn(
+                    tokens, positions, k_pool, v_pool, tables, kv_lens)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        k_pool, v_pool)
+
+            return jax.jit(decode, donate_argnums=donate)
+
+        def prefill(tokens, length, k_pool, v_pool, pages):
+            logits, k, v = model.prefill_fn(tokens, length)
+            k_pool, v_pool = write_prompt_kv(k_pool, v_pool, k, v, pages)
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    k_pool, v_pool)
+
+        return jax.jit(prefill, donate_argnums=donate)
+
+    def warmup(self):
+        """Compile the decode step and every prefill bucket against the
+        scratch page, so no live sequence ever pays a compile."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        with _obs.timed("serving.decode.warmup", slots=cfg.num_slots):
+            step = self._jit.get(("decode",))
+            toks, k_pool, v_pool = step(
+                jnp.zeros((cfg.num_slots,), jnp.int32),
+                jnp.zeros((cfg.num_slots,), jnp.int32),
+                self._cache.k_pool, self._cache.v_pool,
+                jnp.asarray(self._tables),
+                jnp.zeros((cfg.num_slots,), jnp.int32))
+            np.asarray(toks)
+            self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+            for b in self.prefill_buckets:
+                fn = self._jit.get(("prefill", b))
+                toks, k_pool, v_pool = fn(
+                    jnp.zeros((b,), jnp.int32), jnp.int32(1),
+                    self._cache.k_pool, self._cache.v_pool,
+                    jnp.zeros((b // cfg.page_size,), jnp.int32))
+                np.asarray(toks)
+                self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        return self
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if not self._thread.is_alive() and not self._stop:
+            self._thread.start()
+        return self
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()
+
+    def stop(self, drain=True, timeout=None):
+        """Stop generating.  ``drain=True`` finishes every admitted and
+        queued sequence first; ``drain=False`` fails them with
+        ``ServingClosed`` after the in-flight iteration."""
+        self._drain = bool(drain)
+        self._stop = True
+        self._queue.close()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        if not self._thread.is_alive():
+            # leftovers exist only when the worker never ran (or was
+            # asked not to drain): fail them rather than hang futures
+            self._fail_all(ServingClosed("decode scheduler stopped"))
+        return not self._thread.is_alive()
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, deadline_ms=None):
+        """Admit one prompt; returns its :class:`GenerateRequest` future.
+        Raises ``ServingClosed`` when stopped, ``ServingQueueFull`` under
+        backpressure, ``ServingError`` for malformed prompts."""
+        cfg = self.config
+        tokens = np.asarray(prompt)
+        if tokens.ndim != 1 or tokens.shape[0] < 1:
+            raise ServingError(
+                "prompt must be a non-empty 1-D token array, got shape %s"
+                % (tokens.shape,))
+        tokens = tokens.astype(np.int32, copy=False)
+        n_new = int(cfg.max_new_tokens if max_new_tokens is None
+                    else max_new_tokens)
+        if n_new < 1:
+            raise ServingError("max_new_tokens must be >= 1")
+        plen = int(tokens.shape[0])
+        if plen > self.prefill_buckets[-1]:
+            raise ServingError(
+                "prompt length %d exceeds the largest prefill bucket %d"
+                % (plen, self.prefill_buckets[-1]))
+        if plen + n_new > cfg.max_seq_len:
+            raise ServingError(
+                "prompt %d + max_new_tokens %d exceeds max_seq_len %d"
+                % (plen, n_new, cfg.max_seq_len))
+        ms = deadline_ms if deadline_ms is not None else cfg.default_deadline_ms
+        deadline = None if ms is None else time.perf_counter() + ms / 1e3
+        req = self._queue.put(
+            GenerateRequest(tokens, n_new, deadline=deadline))
+        _requests.inc()
+        return req
+
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
+                 timeout=None):
+        """Synchronous generate: the generated int32 token ids."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    def stats(self):
+        active = sum(1 for s in self._slots if s is not None)
+        return {
+            "num_slots": self.config.num_slots,
+            "max_active": self.config.max_active,
+            "active": active,
+            "queue_depth": self._queue.depth(),
+            "admitted": self._queue.last_seq(),
+            "completed": self._completed,
+            "kv_pages_free": self._cache.free_pages,
+            "kv_pages_used": self._cache.used_pages,
+            "kv_occupancy": self._cache.occupancy(),
+            "prefill_buckets": list(self.prefill_buckets),
+        }
+
+    # -- worker --------------------------------------------------------------
+    def _active_count(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    def _recover_pools(self, exc):
+        """After a failed dispatch with donation enabled (TPU), the pool
+        buffers passed in were already consumed — every sequence's cached
+        KV is gone.  Retire all actives with the error and reallocate
+        zeroed pools so the scheduler keeps serving new requests instead
+        of wedging on deleted arrays."""
+        if not self._donated:
+            return
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._retire(i, error=exc)
+        self._cache.reset_pools()
+
+    def _fail_all(self, exc):
+        if self._hol is not None:
+            self._hol.fail(exc)
+            self._hol = None
+        self._queue.drain_remaining(lambda r: exc)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._retire(i, error=exc)
+
+    def _run(self):
+        while True:
+            self._admit()
+            if self._active_count():
+                self._iterate()
+                continue
+            if self._stop and (not self._drain
+                               or (self._queue.depth() == 0
+                                   and self._hol is None)):
+                if not self._drain:
+                    self._fail_all(ServingClosed("decode scheduler stopped"))
+                return
+
+    def _admit(self):
+        """Fill free slots from the queue (iteration-level admission).
+        Never blocks while sequences are decoding; waits briefly when
+        idle so the loop doesn't spin."""
+        cache, cfg = self._cache, self.config
+        while self._active_count() < cfg.max_active:
+            if self._stop and not self._drain:
+                return
+            req = self._hol
+            self._hol = None
+            if req is None:
+                req = self._queue.get(
+                    timeout=0.0 if self._active_count() else 0.05)
+            if req is None:
+                return
+            if req.expired():
+                _expired.inc()
+                req.fail(ServingTimeout(
+                    "deadline expired after %.3fs in decode queue"
+                    % (time.perf_counter() - req.enqueue_ts)))
+                self._completed += 1
+                continue
+            need = cache.pages_for(req.prompt_len + req.max_new_tokens)
+            pages = cache.alloc(need)
+            if pages is None:
+                if not self._active_count() and need > cache.free_pages:
+                    # nothing will ever free enough: the reservation is
+                    # larger than the whole (idle) pool
+                    req.fail(ServingError(
+                        "sequence needs %d pages but the pool has %d "
+                        "usable; raise num_pages or shrink the request"
+                        % (need, cache.free_pages)))
+                    self._completed += 1
+                    continue
+                # pool exhausted: hold the head (FIFO) until a retirement
+                # frees its reservation
+                self._hol = req
+                return
+            self._prefill(req, pages)
+
+    def _prefill(self, req, pages):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        idx = next(i for i, s in enumerate(self._slots) if s is None)
+        bucket = next(b for b in self.prefill_buckets if b >= req.prompt_len)
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[:req.prompt_len] = req.prompt
+        page_vec = np.zeros((bucket // cfg.page_size,), np.int32)
+        n_prompt_pages = self._cache.pages_for(req.prompt_len)
+        page_vec[:n_prompt_pages] = pages[:n_prompt_pages]
+        fn = self._jit.get(("prefill", bucket))
+        now = time.perf_counter()
+        _queue_wait.observe(now - req.enqueue_ts)
+        req.dispatch_ts = now
+        try:
+            with self._telemetry.timed("serving.decode.prefill",
+                                       bucket=bucket, rows=req.prompt_len,
+                                       seq=req.seq):
+                tok, k_pool, v_pool = fn(
+                    jnp.asarray(tokens), jnp.int32(req.prompt_len),
+                    self._cache.k_pool, self._cache.v_pool,
+                    jnp.asarray(page_vec))
+                first = int(np.asarray(tok))
+        except BaseException as exc:  # noqa: BLE001 — worker must survive
+            self._cache.free(pages)
+            self._completed += 1
+            req.fail(exc)
+            self._recover_pools(exc)
+            return
+        _prefill_timer.observe(time.perf_counter() - now)
+        self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        slot = _Slot(req, pages)
+        slot.generated.append(first)
+        req.token_times.append(time.perf_counter())
+        self._slots[idx] = slot
+        self._tables[idx] = self._cache.table_row(pages)
+        _prefills.inc()
+        _tokens.inc()
+        _active_slots.set(self._active_count())
+        self._finish_if_done(idx)
+
+    def _finish_if_done(self, idx):
+        slot = self._slots[idx]
+        eos = self.model.eos_id
+        if (len(slot.generated) >= slot.req.max_new_tokens
+                or (eos is not None and slot.generated[-1] == eos)):
+            self._retire(idx)
+            return True
+        return False
+
+    def _iterate(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        # shed actives whose deadline passed before burning a step on them
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.req.expired():
+                _expired.inc()
+                self._retire(i, error=ServingTimeout(
+                    "deadline expired after %d/%d generated tokens"
+                    % (len(slot.generated), slot.req.max_new_tokens)))
+        active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return
+        tokens = np.zeros((cfg.num_slots,), np.int32)
+        positions = np.zeros((cfg.num_slots,), np.int32)
+        kv_lens = np.zeros((cfg.num_slots,), np.int32)
+        for i, slot in active:
+            tokens[i] = slot.generated[-1]   # feed the last sampled token
+            positions[i] = slot.kv_len       # ... at the next cache index
+            kv_lens[i] = slot.kv_len + 1     # visible kv incl. this token
+        fn = self._jit.get(("decode",))
+        t0 = time.perf_counter()
+        try:
+            with self._telemetry.timed("serving.decode.step",
+                                       active=len(active)):
+                out, k_pool, v_pool = fn(
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    self._cache.k_pool, self._cache.v_pool,
+                    jnp.asarray(self._tables), jnp.asarray(kv_lens))
+                sampled = np.asarray(out)
+        except BaseException as exc:  # noqa: BLE001 — worker must survive
+            for i, _ in active:
+                self._retire(i, error=exc)
+            self._recover_pools(exc)
+            return
+        _decode_timer.observe(time.perf_counter() - t0)
+        self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        now = time.perf_counter()
+        for i, slot in active:
+            slot.kv_len += 1
+            slot.generated.append(int(sampled[i]))
+            slot.req.token_times.append(now)
+        _steps.inc()
+        _tokens.inc(len(active))
+        for i, _ in active:
+            if self._slots[i] is not None:
+                self._finish_if_done(i)
+        _active_slots.set(self._active_count())
+        self._cache.publish_gauges(
+            sum(s.kv_len for s in self._slots if s is not None))
+
+    def _retire(self, idx, error=None):
+        slot = self._slots[idx]
+        self._slots[idx] = None
+        self._tables[idx] = 0
+        self._cache.free(slot.pages)
+        self._completed += 1
+        req = slot.req
+        if error is not None:
+            req.fail(error)
+        else:
+            req.complete(np.asarray(slot.generated, np.int32))
+        _retired.inc()
+        _active_slots.set(self._active_count())
+        tel = self._telemetry
+        if tel.span_active():
+            tel.record_span(
+                "serving.decode.sequence", req.enqueue_wall,
+                time.time() - req.enqueue_wall,
+                tags={"seq": req.seq, "prompt": slot.prompt_len,
+                      "generated": len(slot.generated),
+                      "shed": error is not None})
+        if tel.recording:
+            tel.emit({
+                "type": "decode_sequence", "ts": time.time(),
+                "source": "serving", "seq": req.seq,
+                "prompt_len": slot.prompt_len,
+                "generated": len(slot.generated),
+                "shed": error is not None,
+                "kv_pages_used": self._cache.used_pages,
+                "queue_depth": self._queue.depth(),
+            })

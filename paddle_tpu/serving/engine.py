@@ -65,7 +65,16 @@ class InferenceEngine:
         padded to the smallest covering bucket.  Default ``(2, 4, 8, 16)``
         — see the module docstring for why the floor is 2.
     max_batch_size: coalescing cap (rows per dispatch); defaults to the
-        largest bucket and must not exceed it.
+        largest bucket.  It MAY exceed the largest bucket: a coalesced
+        batch bigger than every bucket is chunked across multiple
+        bucket dispatches (per-request slice order preserved).
+    decode_model: a :class:`~.decode_scheduler.DecodeModel` enables
+        :meth:`generate`/:meth:`generate_async` (continuous-batching
+        autoregressive decode over a paged KV cache) alongside
+        ``predict``.  ``model_dir`` may be None for a generate-only
+        engine.
+    decode_config: :class:`~.decode_scheduler.DecodeConfig` for the
+        decode runtime (slots, KV paging geometry, prefill buckets).
     batch_timeout_ms: extra time the batcher may wait, measured from the
         head request's ARRIVAL, to fill a batch.  The default 0 is eager
         (dispatch whatever is queued — throughput-optimal under backlog
@@ -84,22 +93,21 @@ class InferenceEngine:
         :meth:`start`.
     """
 
-    def __init__(self, model_dir, batch_buckets=(2, 4, 8, 16),
+    def __init__(self, model_dir=None, batch_buckets=(2, 4, 8, 16),
                  max_batch_size=None, batch_timeout_ms=0.0,
                  queue_capacity=128, default_deadline_ms=None, place=None,
                  backend="auto", feed_shapes=None, warmup=True,
-                 autostart=True):
+                 autostart=True, decode_model=None, decode_config=None):
         buckets = sorted(set(int(b) for b in batch_buckets))
         if not buckets or buckets[0] < 1:
             raise ValueError("batch_buckets must be positive ints, got %r"
                              % (batch_buckets,))
+        if model_dir is None and decode_model is None:
+            raise ValueError(
+                "InferenceEngine needs a model_dir (predict), a "
+                "decode_model (generate), or both")
         self.batch_buckets = tuple(buckets)
         self.max_batch_size = int(max_batch_size or buckets[-1])
-        if self.max_batch_size > buckets[-1]:
-            raise ValueError(
-                "max_batch_size %d exceeds the largest bucket %d — no "
-                "compiled shape could cover a full batch"
-                % (self.max_batch_size, buckets[-1]))
         self.batch_timeout_ms = float(batch_timeout_ms)
         self.default_deadline_ms = default_deadline_ms
         self._warmup = bool(warmup)
@@ -107,13 +115,28 @@ class InferenceEngine:
         self._store = ModelStore(place=place, feed_shapes=feed_shapes)
         self._model_lock = threading.Lock()   # guards the active-model flip
         self._swap_lock = threading.Lock()    # serializes swap_model calls
-        self._model = self._store.load(model_dir, backend=backend)
-        if self._warmup:
+        self._model = (None if model_dir is None
+                       else self._store.load(model_dir, backend=backend))
+        if self._warmup and self._model is not None:
             self._model.warmup(self.batch_buckets)
         self._queue = RequestQueue(queue_capacity)
         self._batcher = DynamicBatcher(
             self._queue, self._execute_batch, self.max_batch_size,
             self.batch_timeout_ms / 1e3)
+        self._decoder = None
+        if decode_model is not None:
+            import copy
+
+            from .decode_scheduler import DecodeConfig, DecodeScheduler
+
+            # shallow-copy: the engine's warmup override must not mutate
+            # a caller-owned config reused for other engines
+            cfg = (copy.copy(decode_config) if decode_config is not None
+                   else DecodeConfig(default_deadline_ms=default_deadline_ms))
+            if not self._warmup:
+                cfg.warmup = False
+            self._decoder = DecodeScheduler(decode_model, cfg,
+                                            autostart=False)
         self._telemetry = _obs.get_telemetry()
         # bucket-histogram counter cells resolved once: the dispatch path
         # must not pay a locked registry lookup + string format per batch
@@ -128,6 +151,8 @@ class InferenceEngine:
     def start(self):
         if not self._batcher.alive:
             self._batcher.start()
+        if self._decoder is not None and not self._decoder.alive:
+            self._decoder.start()
         return self
 
     def stop(self, drain=True, timeout=None):
@@ -150,11 +175,13 @@ class InferenceEngine:
                 drain = False
             if not drain:
                 self._queue.drain_remaining()
+            if self._decoder is not None:
+                self._decoder.stop(drain=drain, timeout=timeout)
             # if the join timed out the worker may still be mid-dispatch:
             # leave the model open (a leak at a forced-shutdown edge)
             # rather than closing an executable out from under a running
             # batch
-            if worker_done:
+            if worker_done and self._model is not None:
                 self._model.close()
 
     def __enter__(self):
@@ -177,12 +204,14 @@ class InferenceEngine:
         return self._state in ("ready", "swapping")
 
     def health(self):
-        return {
+        h = {
             "state": self._state,
             "ready": self.ready(),
-            "model_version": self._model.version,
-            "model_dir": self._model.dirname,
-            "backend": self._model.kind,
+            "model_version": None if self._model is None
+            else self._model.version,
+            "model_dir": None if self._model is None
+            else self._model.dirname,
+            "backend": None if self._model is None else self._model.kind,
             "batch_buckets": list(self.batch_buckets),
             "max_batch_size": self.max_batch_size,
             "queue_depth": self._queue.depth(),
@@ -194,18 +223,21 @@ class InferenceEngine:
             "requests": self._queue.last_seq(),
             "batches": self._batcher.batches,
         }
+        if self._decoder is not None:
+            h["decode"] = self._decoder.stats()
+        return h
 
     @property
     def model_version(self):
-        return self._model.version
+        return None if self._model is None else self._model.version
 
     @property
     def feed_names(self):
-        return list(self._model.feed_names)
+        return [] if self._model is None else list(self._model.feed_names)
 
     @property
     def fetch_names(self):
-        return list(self._model.fetch_names)
+        return [] if self._model is None else list(self._model.fetch_names)
 
     # -- request admission ---------------------------------------------------
     def _normalize_feed(self, feed):
@@ -261,6 +293,10 @@ class InferenceEngine:
             raise ServingClosed("engine is stopped")
         if self._state == "loading":
             raise ServingClosed("engine is still loading")
+        if self._model is None:
+            raise ServingError(
+                "this engine has no predict model (constructed with "
+                "model_dir=None); only generate() is available")
         arrays, rows = self._normalize_feed(feed)
         ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
@@ -275,6 +311,31 @@ class InferenceEngine:
         return self.predict_async(feed, deadline_ms=deadline_ms).result(
             timeout=timeout)
 
+    # -- request admission: autoregressive decode ----------------------------
+    def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None):
+        """Admit one generation prompt (1-D token ids); returns its
+        :class:`~.decode_scheduler.GenerateRequest` future whose
+        ``result(timeout)`` is the generated int32 token ids.  Requires
+        the engine to have been constructed with ``decode_model=``.
+        Same error contract as :meth:`predict_async` (``ServingClosed``
+        / ``ServingQueueFull`` / ``ServingError``)."""
+        if self._state == "stopped":
+            raise ServingClosed("engine is stopped")
+        if self._decoder is None:
+            raise ServingError(
+                "this engine has no decode model; construct it with "
+                "decode_model= to use generate()")
+        return self._decoder.submit(prompt, max_new_tokens=max_new_tokens,
+                                    deadline_ms=deadline_ms)
+
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
+                 timeout=None):
+        """Synchronous generate: greedy-decoded int32 token ids (stops at
+        the decode model's ``eos_id`` or ``max_new_tokens``)."""
+        return self.generate_async(
+            prompt, max_new_tokens=max_new_tokens,
+            deadline_ms=deadline_ms).result(timeout=timeout)
+
     # -- batch execution (batcher thread) ------------------------------------
     def _bucket_for(self, rows):
         for b in self.batch_buckets:
@@ -282,56 +343,107 @@ class InferenceEngine:
                 return b
         return self.batch_buckets[-1]
 
-    def _execute_batch(self, requests):
-        with self._model_lock:
-            model = self._model
-        rows = sum(r.rows for r in requests)
-        bucket = self._bucket_for(rows)
-        pad = bucket - rows
+    def _dispatch_chunk(self, model, feed_full, lo, hi, n_requests):
+        """Run rows [lo, hi) of the concatenated batch as one padded
+        bucket dispatch; returns ``(outs, batched_flags)``."""
+        n = hi - lo
+        bucket = self._bucket_for(n)
+        pad = bucket - n
         feed = {}
-        for name in model.feed_names:
-            parts = [r.feed[name] for r in requests]
+        for name, arr in feed_full.items():
+            chunk = arr[lo:hi]
             if pad:
                 # edge-replicate the last row: always a valid sample, and
                 # padding never changes other rows' results (rows are
                 # computed independently)
-                parts.append(np.broadcast_to(
-                    parts[-1][-1:], (pad,) + parts[-1].shape[1:]))
-            feed[name] = (parts[0] if len(parts) == 1
-                          else np.concatenate(parts, axis=0))
+                chunk = np.concatenate(
+                    [chunk, np.broadcast_to(chunk[-1:],
+                                            (pad,) + chunk.shape[1:])],
+                    axis=0)
+            feed[name] = chunk
         tel = self._telemetry
-        now = time.perf_counter()
-        for r in requests:
-            _queue_wait.observe(now - r.enqueue_ts)
-        with tel.timed("serving.execute", bucket=bucket, rows=rows,
-                       requests=len(requests), version=model.version):
+        with tel.timed("serving.execute", bucket=bucket, rows=n,
+                       requests=n_requests, version=model.version):
             outs = model.predict_batch(feed)
         _batches.inc()
-        _batched_rows.inc(rows)
+        _batched_rows.inc(n)
         _padded_rows.inc(pad)
         self._bucket_counters[bucket].inc()
-        offset = 0
-        done_wall = time.time()
-        spans = tel.span_active()
         # which outputs carry the batch dim: warmup's observed ground
         # truth when available (a non-batched fetch whose leading dim
         # coincidentally equals one bucket must NOT be sliced), else the
         # shape heuristic
-        batched = model.batched_fetch
+        known = model.batched_fetch
+        outs = [np.asarray(o) for o in outs]
+        flags = [(a.ndim >= 1 and a.shape[0] == bucket
+                  if known is None or j >= len(known) else known[j])
+                 for j, a in enumerate(outs)]
+        if tel.recording:
+            tel.emit({
+                "type": "serve_batch", "ts": time.time(),
+                "source": "serving", "bucket": bucket, "rows": n,
+                "requests": n_requests, "padded": pad,
+                "model_version": model.version,
+                "queue_depth": self._queue.depth(),
+            })
+        return outs, flags
+
+    def _execute_batch(self, requests):
+        with self._model_lock:
+            model = self._model
+        rows = sum(r.rows for r in requests)
+        feed_full = {}
+        for name in model.feed_names:
+            parts = [r.feed[name] for r in requests]
+            feed_full[name] = (parts[0] if len(parts) == 1
+                               else np.concatenate(parts, axis=0))
+        tel = self._telemetry
+        now = time.perf_counter()
+        for r in requests:
+            _queue_wait.observe(now - r.enqueue_ts)
+        cap = self.batch_buckets[-1]
+        if rows <= cap:
+            outs, flags = self._dispatch_chunk(model, feed_full, 0, rows,
+                                               len(requests))
+        else:
+            # an oversized coalesced batch (max_batch_size above the
+            # largest bucket, or oversized direct queue use) is CHUNKED
+            # across several bucket dispatches in row order — bucket
+            # padding never goes negative, per-request slices are
+            # reassembled below exactly as in the single-dispatch case
+            bounds = [(lo, min(lo + cap, rows))
+                      for lo in range(0, rows, cap)]
+            per_chunk = []
+            flags = None
+            for lo, hi in bounds:
+                n_req = sum(1 for r_lo, r_hi in self._request_spans(requests)
+                            if r_lo < hi and r_hi > lo)
+                outs_c, flags_c = self._dispatch_chunk(model, feed_full,
+                                                       lo, hi, n_req)
+                per_chunk.append((outs_c, flags_c, hi - lo))
+                flags = flags_c if flags is None else flags
+            outs = []
+            for j in range(len(per_chunk[0][0])):
+                if flags[j]:
+                    outs.append(np.concatenate(
+                        [c_outs[j][:n] for c_outs, _, n in per_chunk],
+                        axis=0))
+                else:
+                    # batch-dim-less fetch (scalar metric): each chunk
+                    # computes its own; share the first chunk's verbatim
+                    outs.append(per_chunk[0][0][j])
+        offset = 0
+        done_wall = time.time()
+        spans = tel.span_active()
         for r in requests:
             result = []
-            for j, out in enumerate(outs):
-                a = np.asarray(out)
-                is_batched = (a.ndim >= 1 and a.shape[0] == bucket
-                              if batched is None or j >= len(batched)
-                              else batched[j])
-                if is_batched:
+            for j, a in enumerate(outs):
+                if flags[j]:
                     # copy: a view would pin the whole batch (and every
                     # other request's rows) in memory via its base
                     result.append(np.ascontiguousarray(
                         a[offset:offset + r.rows]))
                 else:
-                    # batch-dim-less fetch (scalar metric): shared verbatim
                     result.append(a)
             offset += r.rows
             r.complete(result)
@@ -339,15 +451,15 @@ class InferenceEngine:
                 tel.record_span(
                     "serving.request", r.enqueue_wall,
                     done_wall - r.enqueue_wall,
-                    tags={"rows": r.rows, "bucket": bucket, "seq": r.seq})
-        if tel.recording:
-            tel.emit({
-                "type": "serve_batch", "ts": done_wall,
-                "source": "serving", "bucket": bucket, "rows": rows,
-                "requests": len(requests), "padded": pad,
-                "model_version": model.version,
-                "queue_depth": self._queue.depth(),
-            })
+                    tags={"rows": r.rows, "seq": r.seq})
+
+    @staticmethod
+    def _request_spans(requests):
+        spans, lo = [], 0
+        for r in requests:
+            spans.append((lo, lo + r.rows))
+            lo += r.rows
+        return spans
 
     # -- hot swap ------------------------------------------------------------
     def swap_model(self, model_dir, backend="auto", drain_timeout_s=60.0):
@@ -359,6 +471,10 @@ class InferenceEngine:
         the new version number."""
         if self._state == "stopped":
             raise ServingClosed("engine is stopped")
+        if self._model is None:
+            raise ServingError(
+                "this engine has no predict model to swap (constructed "
+                "with model_dir=None)")
         with self._swap_lock:
             if self._state == "stopped":  # stop() won the lock first
                 raise ServingClosed("engine is stopped")

@@ -1,0 +1,193 @@
+"""Paged KV cache: a preallocated page pool + per-sequence page tables.
+
+The memory half of the continuous-batching decode runtime (vLLM /
+PagedAttention, Kwon et al. SOSP'23): instead of one contiguous
+``[B, max_seq_len, ...]`` cache slab per sequence — whose worst-case
+reservation is what caps batch size long before compute does — keys and
+values live in fixed-size PAGES of a pool preallocated once per layer,
+``[num_pages, page_size, H, D]``, and each sequence owns an ordered list
+of page ids (its page table).  Admission allocates, retirement frees, and
+the pool's occupancy — not a worst-case rectangle — is what bounds how
+many sequences decode concurrently.
+
+Allocation discipline (decode_scheduler.py is the only caller):
+
+* **allocate-on-admit**: a sequence reserves ``ceil((prompt_len +
+  max_new_tokens) / page_size)`` pages up front, so decode can never hit
+  mid-flight pool exhaustion — a request that doesn't fit simply waits in
+  the admission queue.  The cost is internal fragmentation (reserved but
+  not-yet-written slots), published as a gauge rather than hidden.
+* **free-on-retire**: the whole reservation returns to the free list the
+  moment the sequence finishes/sheds.  Freed pages are NOT scrubbed —
+  stale values are unreachable because every read masks by the owning
+  sequence's ``kv_lens``.
+* **page 0 is the scratch page**: never allocated.  Inactive decode slots
+  point their whole page table at it, so the fixed-shape decode step can
+  unconditionally scatter its per-slot k/v write — inactive slots write
+  garbage to scratch instead of needing a ragged dispatch.
+
+The pools are jax arrays updated FUNCTIONALLY (``x.at[...].set``) by the
+pure helpers below, which the scheduler jits into its prefill/decode
+steps; the cache object holds the current buffers plus the host-side
+allocator state and telemetry gauges (``serving.decode.kv_*``).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from .. import observability as _obs
+from .errors import ServingError
+
+__all__ = ["PagedKVCache", "write_prompt_kv", "write_token_kv"]
+
+_pages_total = _obs.gauge("serving.decode.kv_pages_total")
+_pages_used = _obs.gauge("serving.decode.kv_pages_used")
+_occupancy = _obs.gauge("serving.decode.kv_occupancy")
+_fragmentation = _obs.gauge("serving.decode.kv_fragmentation")
+
+
+def write_prompt_kv(k_pool, v_pool, k_new, v_new, pages):
+    """Scatter a prefilled prompt's whole-page blocks into the pools.
+
+    k_new/v_new: ``[L, T, H, D]`` with ``T % page_size == 0`` (the prefill
+    bucket is a page multiple); ``pages``: ``[T // page_size]`` int32 page
+    ids — entries past the sequence's real need point at the scratch page,
+    so the scatter shape stays static per bucket.  Returns the updated
+    ``(k_pool, v_pool)``.
+    """
+    L, T, H, D = k_new.shape
+    ps = k_pool.shape[2]
+    n = T // ps
+    kb = k_new.reshape(L, n, ps, H, D)
+    vb = v_new.reshape(L, n, ps, H, D)
+    return k_pool.at[:, pages].set(kb), v_pool.at[:, pages].set(vb)
+
+
+def write_token_kv(k_pool, v_pool, k_tok, v_tok, pages, offsets):
+    """Scatter one decode step's per-slot token k/v into the pools.
+
+    k_tok/v_tok: ``[L, S, H, D]``; ``pages``/``offsets``: ``[S]`` int32 —
+    slot s's token lands at ``pool[:, pages[s], offsets[s]]``.  Inactive
+    slots aim at the scratch page (duplicate scratch writes are fine:
+    nothing ever reads it).  Returns the updated ``(k_pool, v_pool)``.
+    """
+    return (k_pool.at[:, pages, offsets].set(k_tok),
+            v_pool.at[:, pages, offsets].set(v_tok))
+
+
+class PagedKVCache:
+    """Preallocated paged pools + the host-side page allocator.
+
+    Parameters
+    ----------
+    num_layers / num_heads / head_dim: model dims; the pools are
+        ``[L, num_pages, page_size, H, D]`` (k and v).
+    num_pages: pool size INCLUDING the reserved scratch page 0.
+    page_size: tokens per page.
+    max_seq_len: longest sequence the runtime will hold; fixes the
+        per-slot page-table width ``max_pages_per_seq``.
+    dtype: pool dtype (bf16 halves HBM on chip; f32 default for the
+        bitwise CPU contract).
+    """
+
+    def __init__(self, num_layers, num_pages, page_size, num_heads,
+                 head_dim, max_seq_len, dtype="float32"):
+        import jax.numpy as jnp
+
+        if num_pages < 2:
+            raise ServingError(
+                "num_pages must be >= 2 (page 0 is the reserved scratch "
+                "page), got %d" % num_pages)
+        if page_size < 1 or max_seq_len < 1:
+            raise ServingError("page_size and max_seq_len must be >= 1")
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.max_seq_len = int(max_seq_len)
+        self.max_pages_per_seq = -(-self.max_seq_len // self.page_size)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k_pool = jnp.zeros(shape, self.dtype)
+        self.v_pool = jnp.zeros(shape, self.dtype)
+        # page 0 = scratch; everything else starts free
+        self._free = collections.deque(range(1, self.num_pages))
+        self._used = 0
+        _pages_total.set(self.num_pages - 1)
+        self._publish(0)
+
+    def reset_pools(self):
+        """Reallocate zeroed pools (allocator state untouched).  The
+        recovery path after a failed DONATED dispatch, whose consumed
+        input buffers are gone either way."""
+        import jax.numpy as jnp
+
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k_pool = jnp.zeros(shape, self.dtype)
+        self.v_pool = jnp.zeros(shape, self.dtype)
+
+    # -- allocator -----------------------------------------------------------
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self._used
+
+    def pages_for(self, tokens):
+        """Pages a ``tokens``-long sequence reserves (ceil)."""
+        return -(-int(tokens) // self.page_size)
+
+    def alloc(self, n):
+        """Reserve ``n`` pages; returns their ids or None when the pool
+        can't cover the reservation (the caller queues the sequence)."""
+        n = int(n)
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        self._used += n
+        return pages
+
+    def free(self, pages):
+        """Return a retired sequence's reservation to the free list."""
+        for p in pages:
+            if p == 0:
+                raise ServingError("page 0 is the scratch page; never owned")
+            self._free.append(p)
+        self._used -= len(pages)
+
+    # -- telemetry -----------------------------------------------------------
+    def _publish(self, live_tokens):
+        usable = self.num_pages - 1
+        _pages_used.set(self._used)
+        _occupancy.set(self._used / usable if usable else 0.0)
+        cap = self._used * self.page_size
+        # internal fragmentation: reserved-but-unwritten fraction of the
+        # allocated capacity (allocate-on-admit's rent)
+        _fragmentation.set(1.0 - live_tokens / cap if cap else 0.0)
+
+    def publish_gauges(self, live_tokens):
+        """Refresh occupancy/fragmentation gauges; the scheduler calls this
+        once per iteration with the total live (written) token count."""
+        self._publish(int(live_tokens))
+
+    def fragmentation(self, live_tokens):
+        cap = self._used * self.page_size
+        return 1.0 - int(live_tokens) / cap if cap else 0.0
+
+    def occupancy(self):
+        usable = self.num_pages - 1
+        return self._used / usable if usable else 0.0
+
+    def table_row(self, pages):
+        """A fixed-width ``[max_pages_per_seq]`` int32 page-table row for
+        ``pages`` (tail entries -> scratch page 0)."""
+        row = np.zeros((self.max_pages_per_seq,), np.int32)
+        row[:len(pages)] = pages
+        return row

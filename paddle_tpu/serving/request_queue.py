@@ -92,9 +92,14 @@ class Request:
 
 
 class RequestQueue:
-    """Bounded FIFO of :class:`Request` with typed admission errors."""
+    """Bounded FIFO of :class:`Request` with typed admission errors.
 
-    def __init__(self, capacity=128):
+    ``depth_gauge``/``full_counter`` let a co-hosted queue publish to its
+    own telemetry cells (the decode runtime's ``serving.decode.*`` names)
+    instead of the predict path's defaults.
+    """
+
+    def __init__(self, capacity=128, depth_gauge=None, full_counter=None):
         if capacity < 1:
             raise ValueError("queue capacity must be >= 1")
         self.capacity = int(capacity)
@@ -103,6 +108,9 @@ class RequestQueue:
         self._not_empty = threading.Condition(self._lock)
         self._seq = 0
         self._closed = False
+        self._depth_gauge = depth_gauge if depth_gauge is not None else _queue_depth
+        self._full_counter = (full_counter if full_counter is not None
+                              else _queue_full)
         # NOTE: the serving.queue_depth gauge is process-wide (last
         # writer wins across co-hosted engines) — deliberately NOT reset
         # here, so constructing a second engine can't zero it while the
@@ -116,7 +124,7 @@ class RequestQueue:
             if self._closed:
                 raise ServingClosed("engine is stopped; request rejected")
             if len(self._items) >= self.capacity:
-                _queue_full.inc()
+                self._full_counter.inc()
                 raise ServingQueueFull(
                     "request queue at capacity (%d); shed load or retry"
                     % self.capacity)
@@ -125,7 +133,7 @@ class RequestQueue:
             request.enqueue_wall = time.time()
             request.enqueue_ts = time.perf_counter()
             self._items.append(request)
-            _queue_depth.set(len(self._items))
+            self._depth_gauge.set(len(self._items))
             self._not_empty.notify()
         return request
 
@@ -144,7 +152,7 @@ class RequestQueue:
             if max_rows is not None and self._items[0].rows > max_rows:
                 return None
             req = self._items.popleft()
-            _queue_depth.set(len(self._items))
+            self._depth_gauge.set(len(self._items))
             return req
 
     def depth(self):
@@ -176,9 +184,9 @@ class RequestQueue:
         while True:
             with self._lock:
                 if not self._items:
-                    _queue_depth.set(0)
+                    self._depth_gauge.set(0)
                     return failed
                 req = self._items.popleft()
-                _queue_depth.set(len(self._items))
+                self._depth_gauge.set(len(self._items))
             req.fail(make(req))
             failed += 1

@@ -1,0 +1,282 @@
+"""Continuous-batching decode runtime: paged KV cache, scheduler, engine
+generate() — the unit half of the ISSUE 6 acceptance (the end-to-end
+throughput/bitwise/no-recompile gate lives in test_decode_gate.py).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+from paddle_tpu.executor import compile_count  # noqa: E402
+from paddle_tpu.models import transformer as T  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def decode_model():
+    params, meta = T.lm_params(seed=7, vocab_size=50, n_layer=2, n_head=2,
+                               d_model=32, d_inner=64, max_length=128)
+    return T.build_decode_model(params, meta)
+
+
+def _cfg(**kw):
+    base = dict(num_slots=4, page_size=8, max_seq_len=64, max_new_tokens=8)
+    base.update(kw)
+    return serving.DecodeConfig(**base)
+
+
+def _prompts(n, rng, lo=2, hi=24, vocab=50):
+    return [rng.randint(1, vocab, size=rng.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+# -- paged KV cache ----------------------------------------------------------
+
+class TestPagedKVCache:
+    def test_alloc_free_accounting(self):
+        c = serving.PagedKVCache(2, num_pages=9, page_size=4, num_heads=2,
+                                 head_dim=8, max_seq_len=32)
+        assert c.free_pages == 8 and c.used_pages == 0
+        a = c.alloc(3)
+        b = c.alloc(5)
+        assert len(a) == 3 and len(b) == 5 and c.free_pages == 0
+        assert 0 not in a and 0 not in b  # scratch page never handed out
+        assert c.alloc(1) is None         # exhausted -> None, not raise
+        c.free(a)
+        assert c.free_pages == 3 and c.used_pages == 5
+        assert sorted(c.alloc(3)) == sorted(a)  # recycled
+
+    def test_pages_for_and_table_row(self):
+        c = serving.PagedKVCache(1, num_pages=17, page_size=4, num_heads=2,
+                                 head_dim=8, max_seq_len=32)
+        assert c.pages_for(1) == 1 and c.pages_for(4) == 1
+        assert c.pages_for(5) == 2 and c.pages_for(32) == 8
+        assert c.max_pages_per_seq == 8
+        row = c.table_row([3, 5])
+        assert row.shape == (8,) and row.dtype == np.int32
+        assert list(row[:2]) == [3, 5] and (row[2:] == 0).all()
+
+    def test_occupancy_fragmentation_gauges(self):
+        c = serving.PagedKVCache(1, num_pages=11, page_size=4, num_heads=2,
+                                 head_dim=8, max_seq_len=16)
+        assert obs.gauge("serving.decode.kv_pages_total").value == 10
+        c.alloc(5)
+        c.publish_gauges(live_tokens=12)  # 12 of 20 reserved slots written
+        assert obs.gauge("serving.decode.kv_pages_used").value == 5
+        assert obs.gauge("serving.decode.kv_occupancy").value == 0.5
+        assert abs(obs.gauge("serving.decode.kv_fragmentation").value
+                   - (1 - 12 / 20)) < 1e-9
+
+    def test_write_token_and_prompt_kv(self):
+        import jax.numpy as jnp
+
+        c = serving.PagedKVCache(2, num_pages=5, page_size=4, num_heads=2,
+                                 head_dim=4, max_seq_len=16)
+        k = jnp.asarray(np.random.RandomState(0).randn(2, 8, 2, 4)
+                        .astype(np.float32))
+        v = k + 1
+        kp, vp = serving.write_prompt_kv(c.k_pool, c.v_pool, k, v,
+                                         jnp.asarray([2, 3], np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(kp)[:, 2:4].reshape(2, 8, 2, 4), np.asarray(k))
+        tok_k = jnp.ones((2, 3, 2, 4), jnp.float32)  # S=3 slots
+        kp2, vp2 = serving.write_token_kv(
+            kp, vp, tok_k, tok_k * 2,
+            jnp.asarray([1, 4, 0], np.int32), jnp.asarray([2, 0, 0],
+                                                          np.int32))
+        assert (np.asarray(kp2)[:, 1, 2] == 1).all()
+        assert (np.asarray(vp2)[:, 4, 0] == 2).all()
+
+
+# -- scheduler ---------------------------------------------------------------
+
+class TestDecodeScheduler:
+    def test_continuous_equals_naive_bitwise(self, decode_model):
+        rng = np.random.RandomState(0)
+        prompts = _prompts(10, rng)
+        cb = serving.DecodeScheduler(decode_model, _cfg())
+        futs = [cb.submit(p) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+        cb.stop()
+        naive = serving.DecodeScheduler(decode_model, _cfg(max_active=1))
+        want = [naive.generate(p, timeout=120) for p in prompts]
+        naive.stop()
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert g.tobytes() == w.tobytes(), (
+                "sequence %d differs CB vs per-sequence" % i)
+
+    def test_no_recompiles_after_warmup(self, decode_model):
+        sched = serving.DecodeScheduler(decode_model, _cfg())
+        rng = np.random.RandomState(1)
+        c0 = compile_count()
+        futs = [sched.submit(p) for p in _prompts(8, rng)]
+        for f in futs:
+            f.result(timeout=120)
+        assert compile_count() == c0, "decode served with a recompile"
+        sched.stop()
+
+    def test_admits_and_retires_between_iterations(self, decode_model):
+        # more sequences than slots, mixed lengths: the active set must
+        # turn over without ever exceeding num_slots
+        sched = serving.DecodeScheduler(decode_model, _cfg(num_slots=2))
+        rng = np.random.RandomState(2)
+        futs = [sched.submit(p, max_new_tokens=int(m)) for p, m in zip(
+            _prompts(7, rng), rng.randint(1, 9, size=7))]
+        outs = [f.result(timeout=120) for f in futs]
+        st = sched.stats()
+        assert st["completed"] == 7 and st["active"] == 0
+        assert st["kv_pages_used"] == 0  # free-on-retire returned all
+        assert all(o.ndim == 1 for o in outs)
+        sched.stop()
+
+    def test_eos_stops_early(self):
+        params, meta = T.lm_params(seed=7, vocab_size=50, n_layer=2,
+                                   n_head=2, d_model=32, d_inner=64,
+                                   max_length=128)
+        free = T.build_decode_model(params, meta)
+        ref = serving.DecodeScheduler(free, _cfg())
+        tokens = ref.generate(np.arange(1, 6, dtype=np.int32),
+                              max_new_tokens=16, timeout=120)
+        ref.stop()
+        assert len(tokens) > 1
+        eos = int(tokens[0])  # greedy decode repeats; first token recurs
+        capped = T.build_decode_model(params, meta, eos_id=eos)
+        sched = serving.DecodeScheduler(capped, _cfg())
+        out = sched.generate(np.arange(1, 6, dtype=np.int32),
+                             max_new_tokens=16, timeout=120)
+        sched.stop()
+        assert int(out[-1]) == eos and len(out) <= len(tokens)
+        assert eos not in out[:-1]
+
+    def test_deadline_shed_in_queue_and_backpressure(self, decode_model):
+        cfg = _cfg(queue_capacity=2, warmup=False)
+        sched = serving.DecodeScheduler(decode_model, cfg, autostart=False)
+        exp0 = obs.counter("serving.decode.expired").value
+        full0 = obs.counter("serving.decode.queue_full").value
+        live = sched.submit(np.array([1, 2, 3], np.int32), max_new_tokens=2)
+        doomed = sched.submit(np.array([1, 2, 3], np.int32),
+                              max_new_tokens=2, deadline_ms=5)
+        with pytest.raises(serving.ServingQueueFull):
+            sched.submit(np.array([1], np.int32))
+        assert obs.counter("serving.decode.queue_full").value == full0 + 1
+        time.sleep(0.05)  # the doomed deadline passes in queue
+        sched.start()
+        assert live.result(timeout=120).shape == (2,)
+        with pytest.raises(serving.ServingTimeout):
+            doomed.result(timeout=120)
+        assert obs.counter("serving.decode.expired").value == exp0 + 1
+        sched.stop()
+        with pytest.raises(serving.ServingClosed):
+            sched.submit(np.array([1], np.int32))
+
+    def test_malformed_prompts(self, decode_model):
+        sched = serving.DecodeScheduler(decode_model,
+                                        _cfg(warmup=False), autostart=False)
+        with pytest.raises(serving.ServingError, match="non-empty"):
+            sched.submit(np.zeros((0,), np.int32))
+        with pytest.raises(serving.ServingError, match="non-empty"):
+            sched.submit(np.zeros((2, 2), np.int32))
+        with pytest.raises(serving.ServingError, match="max_seq_len"):
+            sched.submit(np.arange(40, dtype=np.int32), max_new_tokens=60)
+        with pytest.raises(serving.ServingError, match="prefill bucket"):
+            sched.submit(np.arange(65, dtype=np.int32))
+        sched.stop()
+
+    def test_oversized_reservation_fails_cleanly(self, decode_model):
+        # a request larger than the whole (idle) pool must fail, not wedge
+        cfg = _cfg(num_pages=4, warmup=False)  # 3 usable pages = 24 tokens
+        sched = serving.DecodeScheduler(decode_model, cfg)
+        req = sched.submit(np.arange(1, 24, dtype=np.int32),
+                           max_new_tokens=8)  # needs 4 pages
+        with pytest.raises(serving.ServingError, match="pages"):
+            req.result(timeout=60)
+        # and the scheduler still serves fitting requests afterwards
+        assert sched.generate(np.array([1, 2], np.int32), max_new_tokens=2,
+                              timeout=120).shape == (2,)
+        sched.stop()
+
+    def test_telemetry_schema(self, decode_model):
+        sink = obs.RingBufferSink(record_spans=True)
+        obs.add_sink(sink)
+        try:
+            c0 = {n: obs.counter("serving.decode.%s" % n).value
+                  for n in ("requests", "tokens", "prefills", "steps",
+                            "retired")}
+            sched = serving.DecodeScheduler(decode_model, _cfg())
+            rng = np.random.RandomState(3)
+            futs = [sched.submit(p, max_new_tokens=4)
+                    for p in _prompts(5, rng)]
+            outs = [f.result(timeout=120) for f in futs]
+            sched.stop()
+        finally:
+            obs.remove_sink(sink)
+        d = {n: obs.counter("serving.decode.%s" % n).value - c0[n]
+             for n in c0}
+        assert d["requests"] == 5 and d["prefills"] == 5
+        assert d["retired"] == 5
+        assert d["tokens"] == sum(len(o) for o in outs) == 20
+        assert d["steps"] >= 3  # batched steps, not one per token
+        for tname in ("serving.decode.prefill_step",
+                      "serving.decode.decode_step",
+                      "serving.decode.queue_wait"):
+            assert obs.timer(tname).stats()[0] > 0, tname
+        assert obs.gauge("serving.decode.active_slots").value == 0
+        assert obs.gauge("serving.decode.queue_depth").value == 0
+        recs = [r for r in sink.records if r.get("type") == "decode_sequence"]
+        assert len(recs) == 5
+        for r in recs:
+            for key in ("seq", "prompt_len", "generated", "shed",
+                        "kv_pages_used", "queue_depth"):
+                assert key in r, r
+        assert {s["name"] for s in sink.spans} >= {
+            "serving.decode.sequence", "serving.decode.prefill",
+            "serving.decode.step"}
+
+    def test_stop_drain_false_fails_pending(self, decode_model):
+        sched = serving.DecodeScheduler(decode_model,
+                                        _cfg(warmup=False), autostart=False)
+        reqs = [sched.submit(np.array([1, 2], np.int32)) for _ in range(3)]
+        sched.stop(drain=False)
+        for r in reqs:
+            with pytest.raises(serving.ServingClosed):
+                r.result(timeout=10)
+
+    def test_no_thread_leak(self, decode_model):
+        before = threading.active_count()
+        for _ in range(3):
+            sched = serving.DecodeScheduler(decode_model,
+                                            _cfg(warmup=False))
+            sched.generate(np.array([1, 2, 3], np.int32), max_new_tokens=2,
+                           timeout=120)
+            sched.stop()
+        assert threading.active_count() <= before
+
+
+# -- engine integration ------------------------------------------------------
+
+class TestEngineGenerate:
+    def test_generate_async_and_health(self, decode_model):
+        eng = serving.InferenceEngine(decode_model=decode_model,
+                                      decode_config=_cfg())
+        futs = [eng.generate_async(np.array([3, 4, 5], np.int32),
+                                   max_new_tokens=3) for _ in range(4)]
+        outs = [f.result(timeout=120) for f in futs]
+        assert all(o.tobytes() == outs[0].tobytes() for o in outs)
+        h = eng.health()
+        assert h["decode"]["completed"] == 4
+        assert h["model_version"] is None  # generate-only engine
+        with pytest.raises(serving.ServingError, match="predict"):
+            eng.predict({"x": np.zeros((1, 4), "float32")})
+        with pytest.raises(serving.ServingError, match="swap"):
+            eng.swap_model("/nonexistent")
+        eng.stop()
+        with pytest.raises(serving.ServingClosed):
+            eng.generate(np.array([1], np.int32))
+
+    def test_engine_without_decode_model_refuses_generate(self, tmp_path):
+        with pytest.raises(ValueError, match="model_dir"):
+            serving.InferenceEngine()

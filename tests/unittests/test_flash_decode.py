@@ -1,0 +1,143 @@
+"""Flash-attention decode-contract edge cases (CPU interpret mode) + the
+paged decode attention engines.
+
+The decode runtime leans on exactly these properties of the attention
+stack (ISSUE 6): a fully masked row (``kv_lens == 0``, an inactive decode
+slot) is EXACT ZEROS on every engine; ``kv_lens == S`` degrades to
+unmasked attention; a single-token query (``T_q=1``, the decode shape)
+against a long KV matches the reference; and mixed per-sequence lengths
+in one batch mask independently.  Parity oracle: ``mha_reference``.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.parallel.flash_attention import (  # noqa: E402
+    flash_attention,
+    mha_reference,
+    paged_decode_attention,
+)
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32))
+
+
+def _flash(q, k, v, **kw):
+    return flash_attention(q, k, v, interpret=True, **kw)
+
+
+class TestFlashDecodeContract:
+    def test_kv_lens_zero_is_exact_zeros(self):
+        B, H, T, S, D = 3, 2, 4, 16, 8
+        q, k, v = _rand((B, H, T, D), 0), _rand((B, H, S, D), 1), _rand(
+            (B, H, S, D), 2)
+        lens = jnp.asarray([0, 7, 0], jnp.int32)
+        out = np.asarray(_flash(q, k, v, kv_lens=lens))
+        ref = np.asarray(mha_reference(q, k, v, kv_lens=lens))
+        # the fully masked rows are exact zeros on BOTH engines (not the
+        # degenerate uniform mean a plain softmax would give) ...
+        assert (out[0] == 0).all() and (out[2] == 0).all()
+        assert (ref[0] == 0).all() and (ref[2] == 0).all()
+        # ... and the live row still matches the reference
+        np.testing.assert_allclose(out[1], ref[1], atol=2e-6)
+
+    def test_kv_lens_full_matches_unmasked(self):
+        B, H, T, S, D = 2, 2, 8, 8, 8
+        q, k, v = _rand((B, H, T, D), 3), _rand((B, H, S, D), 4), _rand(
+            (B, H, S, D), 5)
+        lens = jnp.full((B,), S, jnp.int32)
+        out = np.asarray(_flash(q, k, v, kv_lens=lens))
+        ref = np.asarray(mha_reference(q, k, v))
+        np.testing.assert_allclose(out, ref, atol=2e-6)
+
+    def test_single_token_query_long_kv(self):
+        # the decode shape: T_q=1 against a long cache, causal and not
+        B, H, S, D = 2, 2, 256, 8
+        q = _rand((B, H, 1, D), 6)
+        k, v = _rand((B, H, S, D), 7), _rand((B, H, S, D), 8)
+        lens = jnp.asarray([S, 100], jnp.int32)
+        for causal in (False, True):
+            out = np.asarray(_flash(q, k, v, kv_lens=lens, causal=causal))
+            ref = np.asarray(
+                mha_reference(q, k, v, kv_lens=lens, causal=causal))
+            np.testing.assert_allclose(out, ref, atol=2e-6)
+
+    def test_mixed_length_batch(self):
+        B, H, T, S, D = 5, 2, 16, 64, 8
+        q, k, v = _rand((B, H, T, D), 9), _rand((B, H, S, D), 10), _rand(
+            (B, H, S, D), 11)
+        lens = jnp.asarray([0, 1, 17, 63, 64], jnp.int32)
+        out = np.asarray(_flash(q, k, v, kv_lens=lens))
+        ref = np.asarray(mha_reference(q, k, v, kv_lens=lens))
+        assert (out[0] == 0).all() and (ref[0] == 0).all()
+        np.testing.assert_allclose(out, ref, atol=2e-6)
+
+    def test_mixed_length_causal_cross_length(self):
+        B, H, T, S, D = 3, 2, 8, 32, 8
+        q, k, v = _rand((B, H, T, D), 12), _rand((B, H, S, D), 13), _rand(
+            (B, H, S, D), 14)
+        lens = jnp.asarray([5, 20, 32], jnp.int32)
+        out = np.asarray(_flash(q, k, v, kv_lens=lens, causal=True))
+        ref = np.asarray(mha_reference(q, k, v, kv_lens=lens, causal=True))
+        np.testing.assert_allclose(out, ref, atol=2e-6)
+
+
+class TestPagedDecodeAttention:
+    def _setup(self, seed=0, S=4, H=2, Dh=8, P=11, ps=4, MP=3):
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(S, H, Dh).astype(np.float32))
+        kp = jnp.asarray(rng.randn(P, ps, H, Dh).astype(np.float32))
+        vp = jnp.asarray(rng.randn(P, ps, H, Dh).astype(np.float32))
+        pt = jnp.asarray(np.array([[1, 2, 3], [4, 0, 0], [5, 6, 7],
+                                   [0, 0, 0]], np.int32))
+        lens = jnp.asarray(np.array([11, 3, 12, 0], np.int32))
+        return q, kp, vp, pt, lens
+
+    def test_reference_matches_mha_per_slot(self):
+        q, kp, vp, pt, lens = self._setup()
+        out = np.asarray(paged_decode_attention(q, kp, vp, pt, lens,
+                                                impl="reference"))
+        kk = np.asarray(kp)[np.asarray(pt)]
+        vv = np.asarray(vp)[np.asarray(pt)]
+        S, MP, ps, H, Dh = kk.shape
+        kk = kk.reshape(S, MP * ps, H, Dh)
+        vv = vv.reshape(S, MP * ps, H, Dh)
+        for s in range(S):
+            ref = mha_reference(
+                np.asarray(q)[s][None, :, None, :],
+                jnp.asarray(kk[s].transpose(1, 0, 2)[None]),
+                jnp.asarray(vv[s].transpose(1, 0, 2)[None]),
+                kv_lens=jnp.asarray([int(lens[s])]))
+            np.testing.assert_allclose(
+                out[s], np.asarray(ref)[0, :, 0, :], atol=2e-6)
+        assert (out[3] == 0).all()  # inactive slot
+
+    def test_pallas_kernel_matches_reference(self):
+        # the TPU scalar-prefetch page-table kernel, interpreted on CPU
+        q, kp, vp, pt, lens = self._setup(seed=1)
+        ref = np.asarray(paged_decode_attention(q, kp, vp, pt, lens,
+                                                impl="reference"))
+        pal = np.asarray(paged_decode_attention(q, kp, vp, pt, lens,
+                                                impl="pallas",
+                                                interpret=True))
+        np.testing.assert_allclose(pal, ref, atol=2e-6)
+        assert (pal[3] == 0).all()
+
+    def test_page_table_indirection(self):
+        # same kv content through two different physical page layouts
+        # must give identical results: attention reads PAGES, not offsets
+        q, kp, vp, pt, lens = self._setup(seed=2)
+        out1 = np.asarray(paged_decode_attention(q, kp, vp, pt, lens,
+                                                 impl="reference"))
+        perm = np.array([0, 8, 9, 10, 1, 2, 3, 4, 5, 6, 7])  # page renames
+        inv = np.argsort(perm)
+        kp2 = jnp.asarray(np.asarray(kp)[perm])
+        vp2 = jnp.asarray(np.asarray(vp)[perm])
+        pt2 = jnp.asarray(inv[np.asarray(pt)].astype(np.int32))
+        out2 = np.asarray(paged_decode_attention(q, kp2, vp2, pt2, lens,
+                                                 impl="reference"))
+        assert out1.tobytes() == out2.tobytes()

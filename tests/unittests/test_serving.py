@@ -304,3 +304,45 @@ def test_nonbatched_fetch_with_bucket_sized_lead_dim(tmp_path):
         assert probs.shape == (5, 4)
         assert w_got.shape == (8, 4), "non-batched fetch was sliced"
         assert w_got.tobytes() == w_full.tobytes()
+
+
+def test_oversized_batch_chunked_across_buckets(model_dir):
+    """Regression (ISSUE 6): a coalesced batch with more rows than the
+    largest bucket used to compute a NEGATIVE pad and crash in
+    np.broadcast_to; it must instead be chunked across multiple bucket
+    dispatches with per-request slice order preserved, bitwise-equal to
+    sequential serving."""
+    rng = np.random.RandomState(5)
+    # max_batch_size above the largest bucket is now a supported config
+    eng = serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                  max_batch_size=16, backend="program",
+                                  autostart=False)
+    ref = serving.InferenceEngine(model_dir, batch_buckets=BUCKETS,
+                                  backend="program")
+    try:
+        b0 = obs.counter("serving.batches").value
+        # queue BEFORE starting the batcher so one coalesced batch carries
+        # 3+4+2=9 rows > max(batch_buckets)=4 — the old crash shape
+        payloads = [rng.randn(n, 8).astype("float32") for n in (3, 4, 2)]
+        futs = [eng.predict_async({"x": p}) for p in payloads]
+        eng.start()
+        got = [f.result(timeout=60)[0] for f in futs]
+        n_dispatch = obs.counter("serving.batches").value - b0
+        assert n_dispatch >= 3, (
+            "9 rows over a max bucket of 4 must take >= 3 dispatches, "
+            "got %d" % n_dispatch)
+        for p, g in zip(payloads, got):
+            want = np.concatenate(
+                [ref.predict({"x": p[i:i + 1]})[0]
+                 for i in range(p.shape[0])])
+            assert g.shape == p.shape[:1] + (4,)
+            assert g.tobytes() == want.tobytes()
+        # a single oversized request (rows > largest bucket) also chunks
+        big = rng.randn(11, 8).astype("float32")
+        (out,) = eng.predict({"x": big})
+        want = np.concatenate([ref.predict({"x": big[i:i + 1]})[0]
+                               for i in range(11)])
+        assert out.tobytes() == want.tobytes()
+    finally:
+        eng.stop()
+        ref.stop()

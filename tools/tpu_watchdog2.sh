@@ -24,6 +24,10 @@ while true; do
     run_leg /root/repo/BENCH_live.json      3600 python bench.py || all_ok=0
     run_leg /root/repo/FLASH_BWD64_live.txt 2400 python tools/bench_flash_bwd.py || all_ok=0
     run_leg /root/repo/INFERENCE_HLO_SUMMARY.txt 1800 python tools/dump_inference_hlo.py --out /root/repo/INFERENCE_HLO.txt || all_ok=0
+    # round 6: continuous-batching decode numbers on chip (tokens/s,
+    # inter-token latency, pallas paged-attention path) — PERF.md "Decode
+    # throughput" queues this capture
+    run_leg /root/repo/DECODE_live.json     1800 python benchmarks/bench_decode.py || all_ok=0
     [ $all_ok -eq 1 ] || exit 1
     echo "$(date -u +%H:%M:%S) [wd2] SEQUENCE COMPLETE" >> "$LOG"
     exit 0
