@@ -222,9 +222,16 @@ def retry(policy=None, on_retry=None):
 # to let the normal write proceed.  _io_fault(path, op) raises to simulate
 # an intermittent error before the real IO runs.  _feed_fault(feed_arrays)
 # lets the fault harness poison executor feeds (forced-NaN steps).
+# _serve_fault(requests) is consulted by the serving engine's batch
+# dispatch (and the decode scheduler's prefill/decode dispatch) per
+# ATTEMPT with the exact request list — raise to simulate a transient
+# runtime fault, a poison request, or a worker kill; sleep to simulate a
+# slow device (testing.faults.flaky_execute/slow_execute/poison_request/
+# kill_worker).
 _write_fault = None
 _io_fault = None
 _feed_fault = None
+_serve_fault = None
 
 
 def fs_write_bytes(path, data, sync=True):

@@ -36,6 +36,23 @@ backpressure and per-request deadlines fail with typed errors
 resilience retry choke points, and the whole runtime emits ``serving.*``
 telemetry onto the observability registry (docs/serving.md lists the
 schema).
+
+Overload and failure are first-class (docs/serving.md "Priority classes
+and admission control" / "Self-healing dispatch"): requests carry a
+priority class (``interactive``/``batch``/``best_effort`` lanes with
+per-class capacity) and deadlines shed AT ADMISSION with
+``ServingOverloaded`` once the measured service rate says they can't be
+met; PREDICT dispatch faults are retried (transient), bisected
+(poison), and circuit-breaker-counted (persistent, ``ServingDegraded``
+fast-fail + half-open recovery) — decode dispatch faults fail their
+active sequences typed without retry/bisection (iteration state is not
+replayable; see docs/serving.md); a dead worker thread (either path)
+is restarted by the supervisor — an admitted request ALWAYS reaches a
+terminal outcome.
+``testing.faults.flaky_execute``/``slow_execute``/``poison_request``/
+``kill_worker`` inject each failure deterministically, and
+``benchmarks/bench_load.py`` + ``tools/check_slo.py`` gate
+goodput-under-deadline per class against open-loop overload.
 """
 from __future__ import annotations
 
@@ -49,13 +66,16 @@ from .decode_scheduler import (
 from .engine import InferenceEngine
 from .errors import (
     ServingClosed,
+    ServingDegraded,
     ServingError,
+    ServingOverloaded,
     ServingQueueFull,
     ServingTimeout,
 )
 from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
 from .model_store import LoadedModel, ModelStore
-from .request_queue import Request, RequestQueue
+from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
+from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
 
 __all__ = [
     "InferenceEngine",
@@ -64,6 +84,10 @@ __all__ = [
     "LoadedModel",
     "Request",
     "RequestQueue",
+    "PRIORITY_CLASSES",
+    "CircuitBreaker",
+    "ResilientDispatcher",
+    "WorkerSupervisor",
     "DecodeScheduler",
     "DecodeModel",
     "DecodeConfig",
@@ -74,5 +98,7 @@ __all__ = [
     "ServingError",
     "ServingTimeout",
     "ServingQueueFull",
+    "ServingOverloaded",
+    "ServingDegraded",
     "ServingClosed",
 ]

@@ -22,13 +22,24 @@ measured from the HEAD request's arrival: time the head already spent
 queued behind the previous dispatch consumes its window, so a backlogged
 engine still never stalls.  Requests whose deadline expired while queued
 are shed here, at pop time, with a ``ServingTimeout`` — never executed,
-because the client has already stopped listening.
+because the client has already stopped listening.  (The queue ALSO sheds
+deadline-doomed requests at admission once its service-rate estimate is
+warm; pop-time shedding is the backstop for estimate error.)
 
-The batcher also maintains the COMPLETION WATERMARK: requests complete
-strictly in admission order (FIFO queue, single worker), so
-``completed_seq`` is monotone and :meth:`wait_for` — "everything
-admitted before seq N is finished" — is what hot swap's drain step
+The batcher also maintains the COMPLETION WATERMARK: with priority lanes
+requests may complete out of admission order, so ``_mark_done`` tracks
+the completed-seq SET and advances ``completed_seq`` only over a
+contiguous prefix — :meth:`wait_for` ("everything admitted at or before
+seq N is finished") stays exact, which is what hot swap's drain step
 blocks on.
+
+Failure discipline: per-batch faults are ``Exception``s and the worker
+survives them (the engine's ResilientDispatcher retries/bisects before
+anything even reaches the worker's last-resort handler).
+``BaseException`` — the chaos harness's ``kill_worker``, interpreter
+teardown — kills the worker *silently but observably*: the death lands
+on the ``serving.worker_deaths`` counter and the engine's supervisor
+restarts the thread or fails pending requests fast.
 """
 from __future__ import annotations
 
@@ -36,20 +47,23 @@ import threading
 import time
 
 from .. import observability as _obs
-from .errors import ServingTimeout
+from .errors import ServingClosed, ServingDegraded, ServingTimeout
 
 __all__ = ["DynamicBatcher"]
 
 _expired = _obs.counter("serving.expired")
+_queue_wait = _obs.timer("serving.queue_wait")
+_worker_deaths = _obs.counter("serving.worker_deaths")
 
 
 class DynamicBatcher:
     """Coalesce requests from ``queue`` and hand batches to ``execute``.
 
-    ``execute(requests)`` (the engine's padded-bucket dispatch) is called
-    with a non-empty list whose total rows <= ``max_batch_size``; any
-    exception it raises fails every request in the batch and the worker
-    keeps serving — a poison request must not take the engine down.
+    ``execute(requests)`` (the engine's resilient padded-bucket dispatch)
+    is called with a non-empty list whose total rows <=
+    ``max_batch_size``; any ``Exception`` it raises fails every request
+    in the batch and the worker keeps serving — a poison request must
+    not take the engine down.
     """
 
     def __init__(self, queue, execute, max_batch_size, batch_timeout_s,
@@ -58,29 +72,68 @@ class DynamicBatcher:
         self._execute = execute
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_s)
+        self._name = name
         self._stop = False
         self._drain = True
+        self.started = False
         self._done_lock = threading.Lock()
         self._done_cond = threading.Condition(self._done_lock)
         self.completed_seq = 0
+        self._done_seqs = set()        # completed seqs above the watermark
         self.batches = 0
+        self._inflight = None          # batch being dispatched right now
+        # serializes start/restart: a supervisor restart tick and an
+        # operator start() must not race a thread spawn into two workers
+        self._life_lock = threading.Lock()
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)
 
     def start(self):
-        self._thread.start()
+        with self._life_lock:
+            if self._thread.is_alive():
+                return self
+            if self.started:
+                # the worker already ran and died: Thread objects are
+                # single-use, so re-arm via restart() instead of raising
+                # RuntimeError on a dead thread (no-op while stopping)
+                self._restart_locked()
+                return self
+            self.started = True
+            self._thread.start()
         return self
+
+    def restart(self):
+        """Re-arm a DEAD worker with a fresh thread (the supervisor's
+        recovery path); queue, watermark, and batch counts carry over.
+        No-op (False) while stopping or still alive."""
+        with self._life_lock:
+            return self._restart_locked()
+
+    def _restart_locked(self):
+        if self._stop or self._thread.is_alive():
+            return False
+        self._thread = threading.Thread(target=self._run, name=self._name,
+                                        daemon=True)
+        self._thread.start()
+        return True
 
     @property
     def alive(self):
         return self._thread.is_alive()
+
+    @property
+    def stopping(self):
+        return self._stop
 
     # -- drain watermark -----------------------------------------------------
     def _mark_done(self, requests):
         with self._done_cond:
             for r in requests:
                 if r.seq is not None and r.seq > self.completed_seq:
-                    self.completed_seq = r.seq
+                    self._done_seqs.add(r.seq)
+            while (self.completed_seq + 1) in self._done_seqs:
+                self.completed_seq += 1
+                self._done_seqs.discard(self.completed_seq)
             self._done_cond.notify_all()
 
     def wait_for(self, seq, timeout=None):
@@ -110,7 +163,37 @@ class DynamicBatcher:
             return req
 
     def _run(self):
+        try:
+            self._serve_loop()
+        except BaseException:  # noqa: BLE001 — the silent-death choke point
+            # The worker is dying (chaos kill_worker, interpreter
+            # teardown, or a genuinely unexpected escape).  Count it so
+            # the death is observable, fail the batch it died holding —
+            # those requests are in neither the queue nor a terminal
+            # state, and nobody else will ever touch them — then let the
+            # thread end: the supervisor restarts it or fails pending
+            # requests fast.
+            _worker_deaths.inc()
+            inflight, self._inflight = self._inflight, None
+            if inflight:
+                for r in inflight:
+                    if not r.done():
+                        r.fail(ServingDegraded(
+                            "serving worker died mid-dispatch; request "
+                            "aborted"))
+                self._mark_done(inflight)
+            tel = _obs.get_telemetry()
+            if tel.recording:
+                tel.emit({"type": "worker_death", "ts": time.time(),
+                          "source": "serving", "worker": self._name})
+
+    def _serve_loop(self):
         while True:
+            if self._stop and not self._drain:
+                # non-drain stop: exit after the in-flight batch instead
+                # of serving the backlog — stop() fails the leftovers
+                # via drain_remaining once the thread is gone
+                return
             head = self._pop_live(timeout=0.05, max_rows=None)
             if head is None:
                 if self._stop and (not self._drain
@@ -133,21 +216,44 @@ class DynamicBatcher:
             now = time.perf_counter()
             for r in batch:
                 r.dispatch_ts = now
+                _queue_wait.observe(now - r.enqueue_ts)
+            self._inflight = batch
             try:
                 self._execute(batch)
-            except BaseException as exc:  # noqa: BLE001 - worker must survive
+            except Exception as exc:  # noqa: BLE001 - worker must survive
                 for r in batch:
                     if not r.done():
                         r.fail(exc)
+            # feed the queue's service-rate EMA (deadline-aware
+            # admission): failed dispatches occupied the worker too
+            elapsed = time.perf_counter() - now
+            note = getattr(self._queue, "note_service", None)
+            if note is not None:
+                note(rows, elapsed)
             self._mark_done(batch)
+            self._inflight = None
             self.batches += 1
 
     def stop(self, drain=True, timeout=None):
         """Stop the worker.  ``drain=True`` finishes everything already
         queued first (the queue must be closed so no new work arrives);
-        ``drain=False`` exits after the in-flight batch."""
+        ``drain=False`` exits after the in-flight batch.  Either way,
+        requests still queued once the worker is gone — it was already
+        dead, it never started, drain was off, or the join timed out —
+        are failed via ``drain_remaining`` instead of left hanging."""
         self._drain = bool(drain)
         self._stop = True
         if self._thread.is_alive():
             self._thread.join(timeout)
-        return not self._thread.is_alive()
+        stopped = not self._thread.is_alive()
+        if self._queue.depth() and (stopped or timeout is not None):
+            # nothing will ever pop these (dead/wedged worker): fail fast.
+            # A wedged-but-alive worker popping concurrently is safe —
+            # pop and drain each hand any given request to exactly one
+            # owner.
+            self._queue.drain_remaining(
+                lambda r: ServingClosed(
+                    "engine stopped before request ran (worker %s)"
+                    % ("wedged" if not stopped else "exited")),
+                on_fail=lambda r: self._mark_done([r]))
+        return stopped

@@ -44,8 +44,14 @@ import time
 import numpy as np
 
 from .. import observability as _obs
+from .. import resilience as _resilience
 from ..executor import JitStepCache
-from .errors import ServingClosed, ServingError, ServingTimeout
+from .errors import (
+    ServingClosed,
+    ServingDegraded,
+    ServingError,
+    ServingTimeout,
+)
 from .kv_cache import PagedKVCache, write_prompt_kv
 from .request_queue import Request, RequestQueue
 
@@ -58,6 +64,8 @@ _prefills = _obs.counter("serving.decode.prefills")
 _steps = _obs.counter("serving.decode.steps")
 _retired = _obs.counter("serving.decode.retired")
 _expired = _obs.counter("serving.decode.expired")
+_expired_mid_decode = _obs.counter("serving.decode.expired_mid_decode")
+_worker_deaths = _obs.counter("serving.worker_deaths")
 _queue_full = _obs.counter("serving.decode.queue_full")
 _queue_depth = _obs.gauge("serving.decode.queue_depth")
 _active_slots = _obs.gauge("serving.decode.active_slots")
@@ -153,8 +161,9 @@ class GenerateRequest(Request):
 
     __slots__ = ("prompt", "max_new_tokens", "token_times")
 
-    def __init__(self, prompt, max_new_tokens, deadline=None):
-        super().__init__(feed=None, rows=1, deadline=deadline)
+    def __init__(self, prompt, max_new_tokens, deadline=None, priority=None):
+        super().__init__(feed=None, rows=1, deadline=deadline,
+                         priority=priority)
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.token_times = []
@@ -213,9 +222,11 @@ class DecodeScheduler:
                            * cfg.page_size)
             buckets = sorted(set(buckets))
         self.prefill_buckets = tuple(buckets)
-        self._queue = RequestQueue(cfg.queue_capacity,
-                                   depth_gauge=_queue_depth,
-                                   full_counter=_queue_full)
+        self._queue = RequestQueue(
+            cfg.queue_capacity, depth_gauge=_queue_depth,
+            full_counter=_queue_full,
+            shed_counter=_obs.counter("serving.decode.shed_admission"),
+            gauge_prefix="serving.decode.queue_depth")
         self._telemetry = _obs.get_telemetry()
         # pool donation saves an HBM copy per step on chip; CPU jax has no
         # donation and would warn every dispatch
@@ -228,9 +239,23 @@ class DecodeScheduler:
         self._tables = np.zeros(
             (cfg.num_slots, self._cache.max_pages_per_seq), np.int32)
         self._hol = None               # head-of-line request awaiting pages
+        # serializes _hol handoff between the worker (_admit/_fail_all)
+        # and a stop() that timed out joining a wedged-but-alive worker
+        # — an unsynchronized claim could fail AND decode one request
+        self._hol_lock = threading.Lock()
         self._stop = False
         self._drain = True
         self._completed = 0
+        self._retired_total = 0        # SERVED slot retirements only: the
+        # service-rate EMA must not count queue-expiry sheds, mid-decode
+        # sheds, or fault mass-retires as served work, or overload and
+        # failure inflate the rate and disable shed-at-admission exactly
+        # when it matters
+        self.started = False
+        # serializes start/restart/fail_pending: a supervisor give-up
+        # tick and an operator start() must not race a thread spawn
+        # into a double worker or a _fail_all under a live worker
+        self._life_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name="paddle-tpu-decode-scheduler", daemon=True)
         if cfg.warmup:
@@ -289,34 +314,106 @@ class DecodeScheduler:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
-        if not self._thread.is_alive() and not self._stop:
+        with self._life_lock:
+            if self._thread.is_alive() or self._stop:
+                return self
+            if self.started:
+                # the worker already ran and died: Thread objects are
+                # single-use, so re-arm via restart() instead of raising
+                # RuntimeError on a dead thread
+                self._restart_locked()
+                return self
+            self.started = True
             self._thread.start()
         return self
+
+    def restart(self):
+        """Re-arm a DEAD worker with a fresh thread (the supervisor's
+        recovery path); queue, slots, and KV state carry over — a kill
+        lands between state updates, so resuming the loop continues
+        every live sequence.  No-op (False) while stopping or alive."""
+        with self._life_lock:
+            return self._restart_locked()
+
+    def _restart_locked(self):
+        if self._stop or self._thread.is_alive():
+            return False
+        self._thread = threading.Thread(
+            target=self._run, name="paddle-tpu-decode-scheduler", daemon=True)
+        self._thread.start()
+        return True
 
     @property
     def alive(self):
         return self._thread.is_alive()
 
+    @property
+    def stopping(self):
+        return self._stop
+
+    def fail_pending(self, exc):
+        """Fail every queued and active request with ``exc`` — the
+        supervisor's give-up path for a worker that is dead past its
+        restart budget.  ``_fail_all`` mutates worker-owned slot/KV
+        state, so this ENFORCES the dead-worker precondition instead of
+        trusting the caller: a supervisor give-up tick racing an
+        operator ``engine.start()`` revive must not free pages under a
+        live worker (returns False; the next tick sees the live thread
+        and skips).  The life lock serializes the aliveness check with
+        any concurrent restart/start spawn."""
+        with self._life_lock:
+            if self._thread.is_alive():
+                return False
+            self._fail_all(exc)
+        return True
+
     def stop(self, drain=True, timeout=None):
         """Stop generating.  ``drain=True`` finishes every admitted and
         queued sequence first; ``drain=False`` fails them with
-        ``ServingClosed`` after the in-flight iteration."""
+        ``ServingClosed`` after the in-flight iteration.  A worker that
+        is still wedged when the join times out gets its QUEUED requests
+        failed fast (the queue is lock-safe to drain; active slots stay
+        worker-owned — if the worker ever resumes it sees ``_stop`` and
+        fails them itself)."""
         self._drain = bool(drain)
         self._stop = True
         self._queue.close()
         if self._thread.is_alive():
             self._thread.join(timeout)
-        if not self._thread.is_alive():
+        stopped = not self._thread.is_alive()
+        if stopped:
             # leftovers exist only when the worker never ran (or was
-            # asked not to drain): fail them rather than hang futures
-            self._fail_all(ServingClosed("decode scheduler stopped"))
-        return not self._thread.is_alive()
+            # asked not to drain): fail them rather than hang futures.
+            # Under the life lock: a supervisor give-up tick's
+            # fail_pending must not race this into double-retiring a
+            # slot (double cache.free would alias KV pages)
+            with self._life_lock:
+                self._fail_all(ServingClosed("decode scheduler stopped"))
+        elif timeout is not None:
+            # the head-of-line request parked awaiting KV pages is in
+            # neither the queue nor a slot — a wedged worker will never
+            # admit it, so fail it here or its future hangs forever
+            # (the hol lock makes the claim exclusive: a resuming
+            # drain=True worker would otherwise decode the request this
+            # thread just failed)
+            hol = self._take_hol()
+            if hol is not None:
+                hol.fail(ServingClosed(
+                    "engine stopped before request ran (decode worker "
+                    "wedged)"))
+            self._queue.drain_remaining(lambda r: ServingClosed(
+                "engine stopped before request ran (decode worker "
+                "wedged)"))
+        return stopped
 
     # -- client API ----------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=None, deadline_ms=None):
+    def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
+               priority=None):
         """Admit one prompt; returns its :class:`GenerateRequest` future.
         Raises ``ServingClosed`` when stopped, ``ServingQueueFull`` under
-        backpressure, ``ServingError`` for malformed prompts."""
+        backpressure, ``ServingError`` for malformed prompts.
+        ``priority`` is a :data:`~.request_queue.PRIORITY_CLASSES` lane
+        (admission order; decode slots themselves are shared)."""
         cfg = self.config
         tokens = np.asarray(prompt)
         if tokens.ndim != 1 or tokens.shape[0] < 1:
@@ -340,7 +437,8 @@ class DecodeScheduler:
         ms = deadline_ms if deadline_ms is not None else cfg.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
         req = self._queue.put(
-            GenerateRequest(tokens, n_new, deadline=deadline))
+            GenerateRequest(tokens, n_new, deadline=deadline,
+                            priority=priority))
         _requests.inc()
         return req
 
@@ -382,27 +480,82 @@ class DecodeScheduler:
                 self._retire(i, error=exc)
         self._cache.reset_pools()
 
+    def _take_hol(self):
+        """Exclusively claim the parked head-of-line request (or None):
+        the worker, a wedged-timeout stop(), and _fail_all all hand off
+        through here so exactly one owner ever fails/serves it."""
+        with self._hol_lock:
+            req, self._hol = self._hol, None
+            return req
+
+    def _park_hol(self, req):
+        with self._hol_lock:
+            self._hol = req
+
     def _fail_all(self, exc):
-        if self._hol is not None:
-            self._hol.fail(exc)
-            self._hol = None
+        hol = self._take_hol()
+        if hol is not None:
+            hol.fail(exc)
         self._queue.drain_remaining(lambda r: exc)
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._retire(i, error=exc)
 
     def _run(self):
+        try:
+            self._serve_loop()
+        except BaseException:  # noqa: BLE001 — the silent-death choke point
+            # chaos kill_worker / interpreter teardown: count the death
+            # so it is observable, then let the thread end — the
+            # supervisor restarts it (slots and KV carry over) or fails
+            # pending requests fast.
+            _worker_deaths.inc()
+            tel = self._telemetry
+            if tel.recording:
+                tel.emit({"type": "worker_death", "ts": time.time(),
+                          "source": "serving", "worker": "decoder"})
+
+    def _serve_loop(self):
+        # anchors for the queue's service-rate EMA (deadline-aware
+        # admission): retirements per second of BUSY wall time
+        self._note_ts = time.perf_counter()
+        self._note_retired = self._retired_total
         while True:
             self._admit()
             if self._active_count():
+                if self._stop and not self._drain:
+                    # non-drain stop: fail the actives after the
+                    # in-flight iteration instead of decoding every
+                    # sequence to completion (unbounded shutdown)
+                    self._fail_all(ServingClosed("decode scheduler stopped"))
+                    return
                 self._iterate()
+                self._note_throughput()
                 continue
+            # idle: re-anchor so idle gaps don't dilute the rate
+            self._note_ts = time.perf_counter()
+            self._note_retired = self._retired_total
             if self._stop and (not self._drain
                                or (self._queue.depth() == 0
                                    and self._hol is None)):
                 if not self._drain:
                     self._fail_all(ServingClosed("decode scheduler stopped"))
                 return
+
+    def _note_throughput(self):
+        """Feed retired-sequences-per-second into the queue's EMA so
+        decode admission can shed deadline-doomed requests up front
+        (every GenerateRequest is rows=1, so the queue's rows/s IS
+        requests/s here).  Only REAL retirements count — a shed of an
+        already-expired queued request costs ~0 and must not look like
+        served throughput."""
+        done = self._retired_total - self._note_retired
+        if done <= 0:
+            return
+        now = time.perf_counter()
+        self._queue.note_service(done, now - self._note_ts)
+        self._note_ts = now
+        self._note_retired = self._retired_total
 
     def _admit(self):
         """Fill free slots from the queue (iteration-level admission).
@@ -412,8 +565,7 @@ class DecodeScheduler:
         while self._active_count() < cfg.max_active:
             if self._stop and not self._drain:
                 return
-            req = self._hol
-            self._hol = None
+            req = self._take_hol()
             if req is None:
                 req = self._queue.get(
                     timeout=0.0 if self._active_count() else 0.05)
@@ -440,7 +592,7 @@ class DecodeScheduler:
                     continue
                 # pool exhausted: hold the head (FIFO) until a retirement
                 # frees its reservation
-                self._hol = req
+                self._park_hol(req)
                 return
             self._prefill(req, pages)
 
@@ -460,6 +612,9 @@ class DecodeScheduler:
         _queue_wait.observe(now - req.enqueue_ts)
         req.dispatch_ts = now
         try:
+            serve_fault = _resilience._serve_fault
+            if serve_fault is not None:
+                serve_fault([req])
             with self._telemetry.timed("serving.decode.prefill",
                                        bucket=bucket, rows=req.prompt_len,
                                        seq=req.seq):
@@ -468,12 +623,23 @@ class DecodeScheduler:
                     self._cache.k_pool, self._cache.v_pool,
                     jnp.asarray(page_vec))
                 first = int(np.asarray(tok))
-        except BaseException as exc:  # noqa: BLE001 — worker must survive
+        except Exception as exc:  # noqa: BLE001 — worker must survive
             self._cache.free(pages)
             self._completed += 1
             req.fail(exc)
             self._recover_pools(exc)
             return
+        except BaseException:
+            # worker killed mid-prefill: the request is in neither the
+            # queue nor a slot — fail it and release its reservation
+            # before the death propagates, or it would hang forever.
+            # ServingDegraded (not ServingError): the engine is sick,
+            # the request was fine — same taxonomy as the batcher death
+            self._cache.free(pages)
+            self._completed += 1
+            req.fail(ServingDegraded(
+                "decode worker died mid-prefill; request aborted"))
+            raise
         _prefill_timer.observe(time.perf_counter() - now)
         self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
         slot = _Slot(req, pages)
@@ -500,12 +666,21 @@ class DecodeScheduler:
 
         cfg = self.config
         # shed actives whose deadline passed before burning a step on them
+        now0 = time.perf_counter()
         for i, slot in enumerate(self._slots):
-            if slot is not None and slot.req.expired():
+            if slot is not None and slot.req.expired(now0):
+                req = slot.req
+                queued_s = ((req.dispatch_ts or now0) - req.enqueue_ts
+                            if req.enqueue_ts is not None else 0.0)
+                decoding_s = (now0 - req.dispatch_ts
+                              if req.dispatch_ts is not None else 0.0)
                 _expired.inc()
+                _expired_mid_decode.inc()
                 self._retire(i, error=ServingTimeout(
-                    "deadline expired after %d/%d generated tokens"
-                    % (len(slot.generated), slot.req.max_new_tokens)))
+                    "deadline expired mid-decode after %d/%d generated "
+                    "tokens (%.3fs in queue, %.3fs decoding)"
+                    % (len(slot.generated), req.max_new_tokens,
+                       max(0.0, queued_s), max(0.0, decoding_s))))
         active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
         if not active:
             return
@@ -519,6 +694,9 @@ class DecodeScheduler:
         fn = self._jit.get(("decode",))
         t0 = time.perf_counter()
         try:
+            serve_fault = _resilience._serve_fault
+            if serve_fault is not None:
+                serve_fault([s.req for _, s in active])
             with self._telemetry.timed("serving.decode.step",
                                        active=len(active)):
                 out, k_pool, v_pool = fn(
@@ -526,7 +704,7 @@ class DecodeScheduler:
                     self._cache.k_pool, self._cache.v_pool,
                     jnp.asarray(self._tables), jnp.asarray(kv_lens))
                 sampled = np.asarray(out)
-        except BaseException as exc:  # noqa: BLE001 — worker must survive
+        except Exception as exc:  # noqa: BLE001 — worker must survive
             for i, _ in active:
                 self._retire(i, error=exc)
             self._recover_pools(exc)
@@ -553,6 +731,13 @@ class DecodeScheduler:
         self._tables[idx] = 0
         self._cache.free(slot.pages)
         self._completed += 1
+        if error is None:
+            # only SERVED sequences feed the rate EMA: a fault or
+            # mid-decode shed can mass-retire N slots in one instant,
+            # and counting those would spike the estimated service rate
+            # and disable shed-at-admission exactly while the decoder
+            # is failing or drowning
+            self._retired_total += 1
         req = slot.req
         if error is not None:
             req.fail(error)

@@ -26,10 +26,21 @@ model (re)load rides ``io``'s resilience-routed, fault-injectable
 artifact reads; hot swap (:meth:`swap_model`) loads+warms the new
 version while the old serves, drains everything admitted before the
 swap, then flips; health/readiness is a state machine
-(``loading -> ready <-> swapping -> stopped``); and the whole runtime
-reports as first-class ``serving.*`` telemetry — queue-depth gauge,
-batch-size bucket counters, queue-wait/execute timers, and per-request
-spans in the Chrome trace.
+(``loading -> ready <-> swapping -> stopped``, with ``degraded``
+reported while the dispatch circuit breaker is open or the worker is
+dead past its restart budget); and the whole runtime reports as
+first-class ``serving.*`` telemetry — queue-depth gauge, batch-size
+bucket counters, queue-wait/execute timers, and per-request spans in
+the Chrome trace.
+
+Overload/failure contracts (the resilience layer, docs/serving.md):
+requests carry a priority class and optional deadline; admission sheds
+deadline-doomed requests with ``ServingOverloaded`` BEFORE queueing;
+predict dispatch faults are retried (transient), bisected (poison),
+and breaker-counted (persistent), while decode dispatch faults fail
+their active sequences typed without retry; a dead worker thread is
+restarted by the supervisor or pending requests fail fast — an
+admitted request always reaches a terminal outcome.
 """
 from __future__ import annotations
 
@@ -39,10 +50,12 @@ import time
 import numpy as np
 
 from .. import observability as _obs
+from .. import resilience as _resilience
 from .batcher import DynamicBatcher
-from .errors import ServingClosed, ServingError
+from .errors import ServingClosed, ServingDegraded, ServingError
 from .model_store import ModelStore
-from .request_queue import Request, RequestQueue
+from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
+from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
 
 __all__ = ["InferenceEngine"]
 
@@ -51,7 +64,6 @@ _batches = _obs.counter("serving.batches")
 _batched_rows = _obs.counter("serving.batched_rows")
 _padded_rows = _obs.counter("serving.padded_rows")
 _swaps = _obs.counter("serving.swaps")
-_queue_wait = _obs.timer("serving.queue_wait")
 
 
 class InferenceEngine:
@@ -82,8 +94,23 @@ class InferenceEngine:
         latency for fuller batches on sparse-bursty traffic.
     queue_capacity: bounded admission queue; a full queue raises
         ``ServingQueueFull`` (backpressure, not blocking).
+    class_capacity: per-priority-class queue caps, e.g.
+        ``{"best_effort": 16}`` (absent classes default to
+        ``queue_capacity``) — a best-effort flood can't starve
+        interactive admission.
     default_deadline_ms: deadline applied to requests that don't carry
         their own; None = no deadline.
+    execute_retries: transient dispatch failures are retried this many
+        times (exponential backoff) before the batch is bisected; 0
+        disables retry (bisection still isolates poison requests).
+    breaker_threshold: consecutive fatal batches that trip the dispatch
+        circuit breaker (engine degrades, admission fast-fails with
+        ``ServingDegraded``); None disables the breaker.
+    breaker_cooldown_s: open -> half-open cooldown; a successful probe
+        re-closes the breaker.
+    supervise: run the worker supervisor (restart a dead batcher/decode
+        thread, or fail pending requests fast once the restart budget
+        ``worker_max_restarts`` is spent).
     backend: "auto" | "aot" | "program" (ModelStore).
     feed_shapes: ``{name: full_shape}`` overrides for feeds with dynamic
         non-batch dims (same convention as ``aot_feed_shapes``).
@@ -95,9 +122,13 @@ class InferenceEngine:
 
     def __init__(self, model_dir=None, batch_buckets=(2, 4, 8, 16),
                  max_batch_size=None, batch_timeout_ms=0.0,
-                 queue_capacity=128, default_deadline_ms=None, place=None,
+                 queue_capacity=128, class_capacity=None,
+                 default_deadline_ms=None, place=None,
                  backend="auto", feed_shapes=None, warmup=True,
-                 autostart=True, decode_model=None, decode_config=None):
+                 autostart=True, decode_model=None, decode_config=None,
+                 execute_retries=2, breaker_threshold=5,
+                 breaker_cooldown_s=1.0, supervise=True,
+                 worker_max_restarts=3, supervisor_interval_s=0.1):
         buckets = sorted(set(int(b) for b in batch_buckets))
         if not buckets or buckets[0] < 1:
             raise ValueError("batch_buckets must be positive ints, got %r"
@@ -119,10 +150,21 @@ class InferenceEngine:
                        else self._store.load(model_dir, backend=backend))
         if self._warmup and self._model is not None:
             self._model.warmup(self.batch_buckets)
-        self._queue = RequestQueue(queue_capacity)
+        self._queue = RequestQueue(queue_capacity,
+                                   class_capacity=class_capacity)
+        self._breaker = CircuitBreaker(threshold=breaker_threshold,
+                                       cooldown_s=breaker_cooldown_s)
+        self._dispatcher = ResilientDispatcher(
+            self._execute_batch, max_retries=execute_retries,
+            breaker=self._breaker)
         self._batcher = DynamicBatcher(
-            self._queue, self._execute_batch, self.max_batch_size,
+            self._queue, self._dispatcher, self.max_batch_size,
             self.batch_timeout_ms / 1e3)
+        # workers dead past their restart budget, by supervisor target
+        # name ("batcher"/"decoder"): predict admission gates on the
+        # batcher, generate admission on the decoder — a dead decode
+        # worker must not fast-fail the healthy predict path
+        self._failed_workers = set()
         self._decoder = None
         if decode_model is not None:
             import copy
@@ -137,6 +179,36 @@ class InferenceEngine:
                 cfg.warmup = False
             self._decoder = DecodeScheduler(decode_model, cfg,
                                             autostart=False)
+        self._supervisor = None
+        if supervise:
+            sup = WorkerSupervisor(interval_s=supervisor_interval_s,
+                                   max_restarts=worker_max_restarts,
+                                   on_give_up=self._on_worker_give_up)
+            sup.watch(
+                "batcher",
+                should_run=lambda: (self._batcher.started
+                                    and not self._batcher.stopping),
+                is_alive=lambda: self._batcher.alive,
+                restart=self._batcher.restart,
+                fail_pending=lambda: self._queue.drain_remaining(
+                    lambda r: ServingDegraded(
+                        "serving worker died and its restart budget is "
+                        "exhausted"),
+                    # advance the watermark past drained seqs, or a
+                    # revived engine's swap drain stalls on them forever
+                    on_fail=lambda r: self._batcher._mark_done([r])))
+            if self._decoder is not None:
+                dec = self._decoder
+                sup.watch(
+                    "decoder",
+                    should_run=lambda: (dec.started and not dec.stopping),
+                    is_alive=lambda: dec.alive,
+                    restart=dec.restart,
+                    fail_pending=lambda: dec.fail_pending(
+                        ServingDegraded(
+                            "decode worker died and its restart budget "
+                            "is exhausted")))
+            self._supervisor = sup
         self._telemetry = _obs.get_telemetry()
         # bucket-histogram counter cells resolved once: the dispatch path
         # must not pay a locked registry lookup + string format per batch
@@ -148,17 +220,40 @@ class InferenceEngine:
             self.start()
 
     # -- lifecycle -----------------------------------------------------------
+    def _on_worker_give_up(self, worker_name):
+        """Supervisor callback: a worker died past its restart budget —
+        degrade so admissions to THAT worker's path fast-fail instead
+        of queueing into a black hole."""
+        self._failed_workers.add(worker_name)
+
     def start(self):
+        """Start (or explicitly revive) the serving workers.  An
+        operator calling start() on an engine whose worker died — even
+        past the supervisor's restart budget — grants a fresh budget:
+        the give-up state is cleared for every worker that comes back
+        alive, so its admissions stop fast-failing ``ServingDegraded``."""
         if not self._batcher.alive:
             self._batcher.start()
+            if self._batcher.alive:
+                self._failed_workers.discard("batcher")
+                if self._supervisor is not None:
+                    self._supervisor.reset("batcher")
         if self._decoder is not None and not self._decoder.alive:
             self._decoder.start()
+            if self._decoder.alive:
+                self._failed_workers.discard("decoder")
+                if self._supervisor is not None:
+                    self._supervisor.reset("decoder")
+        if self._supervisor is not None:
+            self._supervisor.start()
         return self
 
     def stop(self, drain=True, timeout=None):
         """Stop serving.  ``drain=True`` answers everything already queued
         first; either way, new requests are rejected with
-        ``ServingClosed`` from the moment the stop begins.  An in-flight
+        ``ServingClosed`` from the moment the stop begins, and no queued
+        request is left hanging — requests a dead/wedged worker will
+        never pop are failed via ``drain_remaining``.  An in-flight
         :meth:`swap_model` finishes first (both serialize on the swap
         lock) — so stop never races a swap into resurrecting a stopped
         engine or leaking a half-installed model version."""
@@ -166,15 +261,11 @@ class InferenceEngine:
             if self._state == "stopped":
                 return
             self._state = "stopped"
+            if self._supervisor is not None:
+                self._supervisor.stop()
             self._queue.close()
-            worker_done = True
-            if self._batcher.alive:
-                worker_done = self._batcher.stop(drain=drain,
-                                                 timeout=timeout)
-            else:
-                drain = False
-            if not drain:
-                self._queue.drain_remaining()
+            # batcher.stop fails any leftovers a gone worker can't serve
+            worker_done = self._batcher.stop(drain=drain, timeout=timeout)
             if self._decoder is not None:
                 self._decoder.stop(drain=drain, timeout=timeout)
             # if the join timed out the worker may still be mid-dispatch:
@@ -192,20 +283,47 @@ class InferenceEngine:
         return False
 
     # -- health / introspection ----------------------------------------------
+    def _predict_path_healthy(self):
+        return (self._model is not None
+                and "batcher" not in self._failed_workers
+                and self._breaker.state != "open")
+
+    def _decode_path_healthy(self):
+        return (self._decoder is not None
+                and "decoder" not in self._failed_workers)
+
     @property
     def state(self):
-        """"loading" | "ready" | "swapping" | "stopped"."""
+        """"loading" | "ready" | "degraded" | "swapping" | "stopped".
+        ``degraded`` is DERIVED: the lifecycle state is ``ready`` but at
+        least one serving path is impaired — the predict dispatch
+        circuit breaker is open, or a worker died past its restart
+        budget.  Admission to the impaired path fast-fails with
+        ``ServingDegraded`` until the breaker's half-open probe (or a
+        worker restart) recovers; the other path keeps serving."""
+        if self._state == "ready":
+            if self._failed_workers:
+                return "degraded"
+            if self._breaker.state == "open":
+                return "degraded"
         return self._state
 
     def ready(self):
         """Readiness-probe truth: the engine admits and serves requests
-        ("swapping" still serves — on the outgoing version until the
-        drain completes)."""
-        return self._state in ("ready", "swapping")
+        on AT LEAST ONE path ("swapping" still serves — on the outgoing
+        version until the drain completes).  A predict-only engine with
+        its breaker open is not ready (a load balancer should stop
+        routing here), but a predict+decode engine whose predict path is
+        degraded keeps serving generate() and stays ready — per-path
+        impairment is detailed in :meth:`health` (``breaker``,
+        ``workers``)."""
+        if self._state not in ("ready", "swapping"):
+            return False
+        return self._predict_path_healthy() or self._decode_path_healthy()
 
     def health(self):
         h = {
-            "state": self._state,
+            "state": self.state,
             "ready": self.ready(),
             "model_version": None if self._model is None
             else self._model.version,
@@ -216,6 +334,13 @@ class InferenceEngine:
             "max_batch_size": self.max_batch_size,
             "queue_depth": self._queue.depth(),
             "queue_capacity": self._queue.capacity,
+            "class_depths": self._queue.class_depths(),
+            "service_rate_rows_per_s": self._queue.service_rate,
+            # worker liveness: False means admitted requests would hang
+            # without the supervisor — surface it so orchestrators see a
+            # dead batcher even between supervisor ticks
+            "worker_alive": self._batcher.alive,
+            "breaker": self._breaker.state,
             # per-ENGINE totals (the serving.* registry counters are
             # process-wide and would cross-contaminate co-hosted engines):
             # admitted = the queue's seq watermark, batches = the worker's
@@ -223,6 +348,8 @@ class InferenceEngine:
             "requests": self._queue.last_seq(),
             "batches": self._batcher.batches,
         }
+        if self._supervisor is not None:
+            h["workers"] = self._supervisor.stats()
         if self._decoder is not None:
             h["decode"] = self._decoder.stats()
         return h
@@ -284,10 +411,14 @@ class InferenceEngine:
                 "client-side" % (rows, self.max_batch_size))
         return out, rows
 
-    def predict_async(self, feed, deadline_ms=None):
+    def predict_async(self, feed, deadline_ms=None, priority=None):
         """Admit one request; returns its :class:`Request` future
-        (``.result(timeout)`` / ``.done()``).  Raises ``ServingClosed``
-        when stopped, ``ServingQueueFull`` under backpressure, and
+        (``.result(timeout)`` / ``.done()``).  ``priority`` is one of
+        ``"interactive"`` / ``"batch"`` (default) / ``"best_effort"``.
+        Raises ``ServingClosed`` when stopped, ``ServingQueueFull``
+        under backpressure, ``ServingOverloaded`` when the deadline is
+        already unmeetable (shed at admission), ``ServingDegraded``
+        while the circuit breaker is open or the worker is dead, and
         ``ServingError`` for malformed requests."""
         if self._state == "stopped":
             raise ServingClosed("engine is stopped")
@@ -297,44 +428,70 @@ class InferenceEngine:
             raise ServingError(
                 "this engine has no predict model (constructed with "
                 "model_dir=None); only generate() is available")
+        if "batcher" in self._failed_workers:
+            raise ServingDegraded(
+                "serving worker is dead past its restart budget; "
+                "engine degraded")
         arrays, rows = self._normalize_feed(feed)
+        if priority is not None and priority not in PRIORITY_CLASSES:
+            raise ServingError("unknown priority class %r (know %s)"
+                               % (priority, PRIORITY_CLASSES))
+        # breaker AFTER validation: a malformed request (bad feed OR bad
+        # priority — queue.put's own check runs too late) must not
+        # consume the half-open probe slot (a probe that can never
+        # dispatch would otherwise only recover via the probe lease
+        # expiry)
+        if not self._breaker.allow():
+            raise ServingDegraded(
+                "circuit breaker open (consecutive fatal batches); "
+                "retry after the cooldown")
         ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
-        req = self._queue.put(Request(arrays, rows, deadline=deadline))
+        req = self._queue.put(
+            Request(arrays, rows, deadline=deadline, priority=priority))
         _requests.inc()
         return req
 
-    def predict(self, feed, deadline_ms=None, timeout=None):
+    def predict(self, feed, deadline_ms=None, priority=None, timeout=None):
         """Synchronous predict: returns ``[array per fetch]`` for this
         request's rows (the leading batch dim is preserved; a sample fed
         without a batch dim still comes back with rows=1 leading)."""
-        return self.predict_async(feed, deadline_ms=deadline_ms).result(
+        return self.predict_async(
+            feed, deadline_ms=deadline_ms, priority=priority).result(
             timeout=timeout)
 
     # -- request admission: autoregressive decode ----------------------------
-    def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None):
+    def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None,
+                       priority=None):
         """Admit one generation prompt (1-D token ids); returns its
         :class:`~.decode_scheduler.GenerateRequest` future whose
         ``result(timeout)`` is the generated int32 token ids.  Requires
         the engine to have been constructed with ``decode_model=``.
         Same error contract as :meth:`predict_async` (``ServingClosed``
-        / ``ServingQueueFull`` / ``ServingError``)."""
+        / ``ServingQueueFull`` / ``ServingError``), and the same
+        ``priority`` classes."""
         if self._state == "stopped":
             raise ServingClosed("engine is stopped")
         if self._decoder is None:
             raise ServingError(
                 "this engine has no decode model; construct it with "
                 "decode_model= to use generate()")
+        if "decoder" in self._failed_workers:
+            raise ServingDegraded(
+                "decode worker is dead past its restart budget; "
+                "engine degraded")
         return self._decoder.submit(prompt, max_new_tokens=max_new_tokens,
-                                    deadline_ms=deadline_ms)
+                                    deadline_ms=deadline_ms,
+                                    priority=priority)
 
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
-                 timeout=None):
+                 priority=None, timeout=None):
         """Synchronous generate: greedy-decoded int32 token ids (stops at
         the decode model's ``eos_id`` or ``max_new_tokens``)."""
         return self.generate_async(
             prompt, max_new_tokens=max_new_tokens,
-            deadline_ms=deadline_ms).result(timeout=timeout)
+            deadline_ms=deadline_ms, priority=priority).result(
+            timeout=timeout)
 
     # -- batch execution (batcher thread) ------------------------------------
     def _bucket_for(self, rows):
@@ -389,6 +546,14 @@ class InferenceEngine:
         return outs, flags
 
     def _execute_batch(self, requests):
+        # the serving-dispatch fault choke point: the chaos harness
+        # (testing.faults.flaky_execute / slow_execute / poison_request /
+        # kill_worker) hooks here, per dispatch ATTEMPT, with the exact
+        # request list — so retries and bisected sub-batches each consult
+        # it, exactly like a real per-dispatch runtime fault would hit
+        serve_fault = _resilience._serve_fault
+        if serve_fault is not None:
+            serve_fault(requests)
         with self._model_lock:
             model = self._model
         rows = sum(r.rows for r in requests)
@@ -398,9 +563,6 @@ class InferenceEngine:
             feed_full[name] = (parts[0] if len(parts) == 1
                                else np.concatenate(parts, axis=0))
         tel = self._telemetry
-        now = time.perf_counter()
-        for r in requests:
-            _queue_wait.observe(now - r.enqueue_ts)
         cap = self.batch_buckets[-1]
         if rows <= cap:
             outs, flags = self._dispatch_chunk(model, feed_full, 0, rows,

@@ -2,12 +2,14 @@
 
 Every failure a client of :class:`~paddle_tpu.serving.InferenceEngine`
 can see maps to one of these, so callers distinguish "shed this request"
-(``ServingQueueFull`` — retry elsewhere / later), "the request ran out of
-time" (``ServingTimeout`` — its deadline expired in queue or while
-waiting), and "the engine is gone" (``ServingClosed``) without string
-matching.  ``ServingError`` also covers request-shape mistakes (unknown
-feed name, rows over ``max_batch_size``), which are programming errors —
-no retry will fix them.
+(``ServingQueueFull`` / ``ServingOverloaded`` — retry elsewhere / later),
+"the request ran out of time" (``ServingTimeout`` — its deadline expired
+in queue or while waiting), "the engine is sick" (``ServingDegraded`` —
+circuit breaker open or worker dead, fast-fail until it heals), and "the
+engine is gone" (``ServingClosed``) without string matching.
+``ServingError`` also covers request-shape mistakes (unknown feed name,
+rows over ``max_batch_size``), which are programming errors — no retry
+will fix them.
 """
 from __future__ import annotations
 
@@ -15,6 +17,8 @@ __all__ = [
     "ServingError",
     "ServingTimeout",
     "ServingQueueFull",
+    "ServingOverloaded",
+    "ServingDegraded",
     "ServingClosed",
 ]
 
@@ -31,8 +35,23 @@ class ServingTimeout(ServingError):
 
 
 class ServingQueueFull(ServingError):
-    """Backpressure: the bounded request queue is at capacity.  The
-    request was NOT admitted; shed load or retry after a backoff."""
+    """Backpressure: the bounded request queue (or the request's priority
+    class) is at capacity.  The request was NOT admitted; shed load or
+    retry after a backoff."""
+
+
+class ServingOverloaded(ServingError):
+    """Shed at admission: given the current queue backlog and measured
+    service rate, the request's deadline cannot be met — rejecting it
+    NOW (instead of letting it expire in queue) is what lets the caller
+    fail over while it still has time.  The request was NOT admitted."""
+
+
+class ServingDegraded(ServingError):
+    """The engine is fast-failing admissions: the dispatch circuit
+    breaker is open after consecutive fatal batches, or the serving
+    worker is dead past its restart budget.  Retry after the breaker's
+    cooldown (half-open probes re-close it automatically)."""
 
 
 class ServingClosed(ServingError):

@@ -1,18 +1,39 @@
-"""Bounded request queue + the request/future handle.
+"""Bounded priority request queue + the request/future handle.
 
 The admission edge of the serving runtime: ``put`` either admits a
 request (assigning its monotonically increasing ``seq`` — the hot-swap
-drain watermark) or raises :class:`~.errors.ServingQueueFull` /
-:class:`~.errors.ServingClosed` immediately.  No blocking puts: under
-overload the RIGHT behavior for a serving frontend is an instant,
-typed rejection the caller can turn into load shedding, not an
-unbounded line of threads parked inside the engine.
+drain watermark) or raises a typed rejection immediately.  No blocking
+puts: under overload the RIGHT behavior for a serving frontend is an
+instant, typed rejection the caller can turn into load shedding, not an
+unbounded line of threads parked inside the engine.  Three distinct
+rejections, because the caller's correct reaction differs:
 
-The queue publishes its depth to the ``serving.queue_depth`` gauge on
-every put/pop (gauges always count — reading it never requires a sink),
-and FIFO order is the contract the batcher and the drain watermark both
-lean on: requests complete in admission order, so "everything admitted
-before seq N is done" is one integer comparison.
+- :class:`~.errors.ServingQueueFull` — the queue (or the request's
+  priority class) is at capacity: backpressure, retry elsewhere/later.
+- :class:`~.errors.ServingOverloaded` — deadline-aware shed AT
+  ADMISSION (Clipper, NSDI'17): the request carries a deadline that the
+  current backlog divided by the measured service rate already makes
+  unmeetable, so it is rejected *before* queueing instead of being
+  discovered expired at pop time — the caller learns while it still has
+  time to fail over.
+- :class:`~.errors.ServingClosed` — the engine is stopped.
+
+Priority classes (``interactive`` > ``batch`` > ``best_effort``) are
+three FIFO lanes under one capacity: ``get`` pops the highest-priority
+nonempty lane, FIFO within a lane, and each lane can carry its own
+capacity cap so a flood of best-effort traffic cannot starve
+interactive admission.  Strict priority is tempered by anti-starvation
+aging (``starvation_s``): a lower-lane head that has waited past the
+threshold pops ahead of fresher high-priority arrivals, so a
+deadline-less best-effort request — and the hot-swap drain watermark
+behind it — is delayed, never parked forever.  ``seq`` stays globally monotone in
+ADMISSION order across lanes — the drain watermark's contract — while
+completion order may now reorder across lanes (the batcher tracks
+completed seqs exactly, not as a high-water mark).
+
+The queue publishes its total depth to the ``serving.queue_depth``
+gauge and per-class depths to ``serving.queue_depth_<class>`` on every
+put/pop (gauges always count — reading them never requires a sink).
 """
 from __future__ import annotations
 
@@ -21,35 +42,54 @@ import threading
 import time
 
 from .. import observability as _obs
-from .errors import ServingClosed, ServingQueueFull, ServingTimeout
+from .errors import (
+    ServingClosed,
+    ServingError,
+    ServingOverloaded,
+    ServingQueueFull,
+    ServingTimeout,
+)
 
-__all__ = ["Request", "RequestQueue"]
+__all__ = ["Request", "RequestQueue", "PRIORITY_CLASSES"]
+
+#: Priority lanes, highest first.  ``get`` pops the first nonempty lane.
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+DEFAULT_PRIORITY = "batch"
 
 _queue_depth = _obs.gauge("serving.queue_depth")
 _queue_full = _obs.counter("serving.queue_full")
+_shed_admission = _obs.counter("serving.shed_admission")
 
 
 class Request:
     """One admitted prediction request; doubles as the caller's future.
 
     ``feed`` maps feed name -> numpy array with the rows on axis 0;
-    ``rows`` is that leading dim (shared by every feed).  The batcher
-    fills ``_result`` (a list of per-fetch arrays, sliced back out of
-    the batch) or ``_error`` and fires the event; :meth:`result` is the
-    blocking accessor with deadline semantics.
+    ``rows`` is that leading dim (shared by every feed).  ``priority``
+    is one of :data:`PRIORITY_CLASSES` (default ``"batch"``).  The
+    batcher fills ``_result`` (a list of per-fetch arrays, sliced back
+    out of the batch) or ``_error`` and fires the event; :meth:`result`
+    is the blocking accessor with deadline semantics.  ``done_ts`` is
+    the ``time.perf_counter()`` instant of completion (answer OR typed
+    failure) — the open-loop SLO harness reads it to measure latency
+    without polling.
     """
 
-    __slots__ = ("feed", "rows", "seq", "deadline", "enqueue_wall",
-                 "enqueue_ts", "dispatch_ts", "_event", "_result", "_error")
+    __slots__ = ("feed", "rows", "seq", "deadline", "priority",
+                 "enqueue_wall", "enqueue_ts", "dispatch_ts", "done_ts",
+                 "_event", "_result", "_error")
 
-    def __init__(self, feed, rows, deadline=None):
+    def __init__(self, feed, rows, deadline=None, priority=None):
         self.feed = feed
         self.rows = int(rows)
         self.seq = None              # assigned by RequestQueue.put
         self.deadline = deadline     # absolute time.perf_counter() instant
+        self.priority = priority or DEFAULT_PRIORITY
         self.enqueue_wall = None     # wall clock, for trace spans
         self.enqueue_ts = None       # perf_counter, for queue-wait timing
         self.dispatch_ts = None
+        self.done_ts = None
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -62,10 +102,12 @@ class Request:
 
     def complete(self, result):
         self._result = result
+        self.done_ts = time.perf_counter()
         self._event.set()
 
     def fail(self, exc):
         self._error = exc
+        self.done_ts = time.perf_counter()
         self._event.set()
 
     # -- caller side ---------------------------------------------------------
@@ -82,82 +124,228 @@ class Request:
         if self.deadline is not None:
             remaining = self.deadline - time.perf_counter()
             wait = remaining if wait is None else min(wait, remaining)
-        if not self._event.wait(None if wait is None else max(0.0, wait)):
+        if wait is not None:
+            # an already-passed deadline means a NEGATIVE remaining wait:
+            # clamp so Event.wait gets a sane value and the error below
+            # reports the request's actual age, not "-0.003s"
+            wait = max(0.0, wait)
+        if not self._event.wait(wait):
+            now = time.perf_counter()
+            age = (now - self.enqueue_ts if self.enqueue_ts is not None
+                   else 0.0)
             raise ServingTimeout(
-                "request (seq %s, %d rows) not answered within %.3fs"
-                % (self.seq, self.rows, wait))
+                "request (seq %s, %d rows, %s) unanswered %.3fs after "
+                "admission (result() waited %.3fs%s)"
+                % (self.seq, self.rows, self.priority, max(0.0, age), wait,
+                   "; deadline already expired" if self.expired(now) else ""))
         if self._error is not None:
             raise self._error
         return self._result
 
 
 class RequestQueue:
-    """Bounded FIFO of :class:`Request` with typed admission errors.
+    """Bounded multi-lane FIFO of :class:`Request` with typed admission.
 
-    ``depth_gauge``/``full_counter`` let a co-hosted queue publish to its
-    own telemetry cells (the decode runtime's ``serving.decode.*`` names)
-    instead of the predict path's defaults.
+    ``class_capacity`` maps priority class -> max queued requests of
+    that class (absent classes default to the total ``capacity``), so
+    e.g. ``{"best_effort": 16}`` keeps a best-effort flood from filling
+    the whole queue.  ``depth_gauge``/``full_counter``/``shed_counter``
+    let a co-hosted queue publish to its own telemetry cells (the decode
+    runtime's ``serving.decode.*`` names) instead of the predict path's
+    defaults.
+
+    Deadline-aware admission needs a service-rate estimate: the batcher
+    calls :meth:`note_service` after every dispatch and the queue keeps
+    an EMA of rows/second.  Until the first sample arrives the estimator
+    is cold and admission never sheds on deadline (a cold engine must
+    not reject its warmup traffic).
     """
 
-    def __init__(self, capacity=128, depth_gauge=None, full_counter=None):
+    def __init__(self, capacity=128, class_capacity=None, depth_gauge=None,
+                 full_counter=None, shed_counter=None, gauge_prefix=None,
+                 starvation_s=2.0):
         if capacity < 1:
             raise ValueError("queue capacity must be >= 1")
         self.capacity = int(capacity)
-        self._items = collections.deque()
+        # anti-starvation aging: a lower-lane head older than this pops
+        # ahead of fresher higher-priority arrivals.  Bounds how long a
+        # deadline-less low-priority request (and the hot-swap drain
+        # watermark behind it) can starve under sustained interactive
+        # load.  None disables aging (pure strict priority).
+        self.starvation_s = None if starvation_s is None else float(
+            starvation_s)
+        self.class_capacity = {cls: self.capacity for cls in PRIORITY_CLASSES}
+        for cls, cap in (class_capacity or {}).items():
+            if cls not in self.class_capacity:
+                raise ValueError("unknown priority class %r (know %s)"
+                                 % (cls, PRIORITY_CLASSES))
+            self.class_capacity[cls] = int(cap)
+        self._lanes = {cls: collections.deque() for cls in PRIORITY_CLASSES}
+        self._lane_rows = {cls: 0 for cls in PRIORITY_CLASSES}
+        self._depth = 0
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._seq = 0
         self._closed = False
+        self._service_rate = None    # EMA rows/second, None until warm
         self._depth_gauge = depth_gauge if depth_gauge is not None else _queue_depth
         self._full_counter = (full_counter if full_counter is not None
                               else _queue_full)
+        self._shed_counter = (shed_counter if shed_counter is not None
+                              else _shed_admission)
+        prefix = gauge_prefix or "serving.queue_depth"
+        self._lane_gauges = {cls: _obs.gauge("%s_%s" % (prefix, cls))
+                             for cls in PRIORITY_CLASSES}
         # NOTE: the serving.queue_depth gauge is process-wide (last
         # writer wins across co-hosted engines) — deliberately NOT reset
         # here, so constructing a second engine can't zero it while the
         # first has queued work.  Per-engine depth: RequestQueue.depth()
         # via engine.health().
 
+    # -- service-rate estimate (deadline-aware admission) --------------------
+    def note_service(self, rows, seconds):
+        """Record one dispatch (``rows`` served in ``seconds`` of worker
+        time) into the service-rate EMA the admission check divides by.
+        Failed dispatches count too: they occupied the worker, which is
+        what a queued request actually waits on."""
+        if seconds <= 0 or rows <= 0:
+            return
+        rate = rows / seconds
+        with self._lock:
+            self._service_rate = (
+                rate if self._service_rate is None
+                else 0.75 * self._service_rate + 0.25 * rate)
+
+    @property
+    def service_rate(self):
+        """EMA rows/second, or None while cold."""
+        return self._service_rate
+
+    def estimated_wait_s(self, priority=DEFAULT_PRIORITY):
+        """Expected queue wait for a request admitted NOW at ``priority``:
+        rows queued at the same or higher priority over the measured
+        service rate.  None while the estimator is cold."""
+        with self._lock:
+            return self._estimated_wait_locked(priority)
+
+    def _estimated_wait_locked(self, priority):
+        if not self._service_rate:
+            return None
+        ahead = 0
+        for cls in PRIORITY_CLASSES:
+            ahead += self._lane_rows[cls]
+            if cls == priority:
+                break
+        return ahead / self._service_rate
+
+    # -- admission -----------------------------------------------------------
     def put(self, request):
         """Admit ``request`` (assigning its ``seq``) or raise
-        ``ServingQueueFull`` / ``ServingClosed``.  Never blocks."""
+        ``ServingQueueFull`` / ``ServingOverloaded`` / ``ServingClosed``.
+        Never blocks."""
+        cls = request.priority
+        if cls not in self._lanes:
+            raise ServingError("unknown priority class %r (know %s)"
+                               % (cls, PRIORITY_CLASSES))
         with self._lock:
             if self._closed:
                 raise ServingClosed("engine is stopped; request rejected")
-            if len(self._items) >= self.capacity:
+            lane = self._lanes[cls]
+            if self._depth >= self.capacity:
                 self._full_counter.inc()
                 raise ServingQueueFull(
                     "request queue at capacity (%d); shed load or retry"
                     % self.capacity)
+            if len(lane) >= self.class_capacity[cls]:
+                self._full_counter.inc()
+                raise ServingQueueFull(
+                    "priority class %r at capacity (%d); shed load or "
+                    "retry" % (cls, self.class_capacity[cls]))
+            if request.deadline is not None:
+                est = self._estimated_wait_locked(cls)
+                now = time.perf_counter()
+                if est is not None and now + est > request.deadline:
+                    self._shed_counter.inc()
+                    raise ServingOverloaded(
+                        "deadline %.0fms away but estimated %s-class "
+                        "queue wait is %.0fms (%d rows ahead at %.0f "
+                        "rows/s); shed at admission"
+                        % (max(0.0, (request.deadline - now)) * 1e3, cls,
+                           est * 1e3, int(round(est * self._service_rate)),
+                           self._service_rate))
             self._seq += 1
             request.seq = self._seq
             request.enqueue_wall = time.time()
             request.enqueue_ts = time.perf_counter()
-            self._items.append(request)
-            self._depth_gauge.set(len(self._items))
+            lane.append(request)
+            self._lane_rows[cls] += request.rows
+            self._depth += 1
+            self._publish_locked(cls)
             self._not_empty.notify()
         return request
 
     def get(self, timeout=None, max_rows=None):
-        """Pop the head request, waiting up to ``timeout`` seconds; None on
-        timeout or when closed-and-empty.  With ``max_rows``, only pops a
-        head that FITS (head.rows <= max_rows) — the batcher's coalesce
-        loop stays FIFO instead of searching the queue for a filler."""
+        """Pop the highest-priority head request, waiting up to
+        ``timeout`` seconds; None on timeout or when closed-and-empty.
+        With ``max_rows``, only pops a lane head that FITS (head.rows <=
+        max_rows) — the batcher's coalesce loop stays FIFO per lane
+        instead of searching the queue for a filler (a lower-priority
+        head that fits may ride along as filler behind a too-big
+        higher-priority head)."""
         with self._lock:
-            if not self._items:
+            if not self._depth:
                 if self._closed:
                     return None
                 self._not_empty.wait(timeout)
-            if not self._items:
-                return None
-            if max_rows is not None and self._items[0].rows > max_rows:
-                return None
-            req = self._items.popleft()
-            self._depth_gauge.set(len(self._items))
-            return req
+            return self._pop_locked(max_rows)
+
+    def _pop_locked(self, max_rows=None):
+        pick = None
+        if self.starvation_s is not None and self._depth:
+            # aging: the OLDEST head that has starved past the threshold
+            # wins over strict priority — sustained interactive load
+            # must not park a best_effort request (and the swap drain
+            # watermark behind it) forever
+            cutoff = time.perf_counter() - self.starvation_s
+            oldest = None
+            for cls in PRIORITY_CLASSES:
+                lane = self._lanes[cls]
+                if (lane and lane[0].enqueue_ts <= cutoff
+                        and (max_rows is None or lane[0].rows <= max_rows)
+                        and (oldest is None
+                             or lane[0].enqueue_ts < oldest)):
+                    oldest = lane[0].enqueue_ts
+                    pick = cls
+        if pick is None:
+            for cls in PRIORITY_CLASSES:
+                lane = self._lanes[cls]
+                if lane and (max_rows is None or lane[0].rows <= max_rows):
+                    pick = cls
+                    break
+        if pick is None:
+            return None
+        req = self._lanes[pick].popleft()
+        self._lane_rows[pick] -= req.rows
+        self._depth -= 1
+        self._publish_locked(pick)
+        return req
+
+    def _publish_locked(self, cls=None):
+        self._depth_gauge.set(self._depth)
+        if cls is None:
+            for c in PRIORITY_CLASSES:
+                self._lane_gauges[c].set(len(self._lanes[c]))
+        else:
+            self._lane_gauges[cls].set(len(self._lanes[cls]))
 
     def depth(self):
         with self._lock:
-            return len(self._items)
+            return self._depth
+
+    def class_depths(self):
+        """{priority class: queued requests} snapshot."""
+        with self._lock:
+            return {cls: len(self._lanes[cls]) for cls in PRIORITY_CLASSES}
 
     def last_seq(self):
         """Seq of the newest ADMITTED request — the drain watermark."""
@@ -175,18 +363,22 @@ class RequestQueue:
     def closed(self):
         return self._closed
 
-    def drain_remaining(self, exc_factory=None):
+    def drain_remaining(self, exc_factory=None, on_fail=None):
         """Pop everything left and fail each request (non-drain shutdown);
-        returns how many were failed."""
+        returns how many were failed.  ``on_fail`` (if given) sees each
+        failed request — the batcher uses it to advance its completion
+        watermark past drained seqs, or ``wait_for``/swap drains would
+        stall forever on requests nobody will ever serve."""
         make = exc_factory or (
             lambda r: ServingClosed("engine stopped before request ran"))
         failed = 0
         while True:
             with self._lock:
-                if not self._items:
-                    self._depth_gauge.set(0)
+                req = self._pop_locked()
+                if req is None:
+                    self._publish_locked()
                     return failed
-                req = self._items.popleft()
-                self._depth_gauge.set(len(self._items))
             req.fail(make(req))
+            if on_fail is not None:
+                on_fail(req)
             failed += 1

@@ -16,14 +16,38 @@ every run:
     with faults.nan_feeds(at_steps=[2]):
         trainer.train(..., nan_guard=True)   # step 2's loss is NaN
 
+The SERVING dispatch path has its own choke point
+(``resilience._serve_fault``, consulted by the engine's batch execute
+and the decode scheduler's prefill/decode dispatch, per attempt, with
+the exact request list), so the serving resilience layer — retry,
+poison bisection, circuit breaker, worker supervisor — is testable the
+same way:
+
+    with faults.flaky_execute(times=2):
+        engine.predict(...)                  # 2 transient faults; retried
+
+    with faults.poison_request(bad.seq):
+        ...                                  # any batch with `bad` dies
+                                             # fatally -> bisected
+
+    with faults.slow_execute(0.05):
+        ...                                  # every dispatch +50ms
+
+    with faults.kill_worker():
+        ...                                  # next dispatch KILLS the
+                                             # worker thread (supervisor!)
+
 No global monkeypatching: only code routed through the resilience
-primitives (checkpoint IO, ``Executor.run`` feeds) sees the faults, and
-exiting the context always restores the hooks — the managers nest but not
-two of the same kind at once.
+primitives (checkpoint IO, ``Executor.run`` feeds, serving dispatch)
+sees the faults, and exiting the context always restores the hooks.
+The serving managers COMPOSE (flaky + poison nested is the standard
+chaos scenario); the IO managers nest but not two of the same kind at
+once.
 """
 from __future__ import annotations
 
 import contextlib
+import time
 
 import numpy as np
 
@@ -31,16 +55,28 @@ from .. import resilience
 
 __all__ = [
     "FaultInjected",
+    "WorkerKilled",
     "torn_write",
     "flaky_io",
     "nan_feeds",
     "flaky_reader",
+    "flaky_execute",
+    "slow_execute",
+    "poison_request",
+    "kill_worker",
 ]
 
 
 class FaultInjected(IOError):
     """Raised by injected faults; an OSError subclass so the default
     transient classifier treats it exactly like a real flaky-FS error."""
+
+
+class WorkerKilled(BaseException):
+    """Raised by :func:`kill_worker` — deliberately a ``BaseException``
+    so the serving worker's fault handling (which survives every
+    ``Exception``) cannot catch it: the worker THREAD dies, which is the
+    failure mode the engine's supervisor exists to detect."""
 
 
 def _match(path, substr):
@@ -155,3 +191,135 @@ def flaky_reader(reader, fail_at, times=1, exc_factory=None):
             yield sample
 
     return faulty
+
+
+# ---------------------------------------------------------------------------
+# serving-dispatch chaos (resilience._serve_fault)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _serve_fault_installed(hook):
+    """Install ``hook`` on the serving-dispatch choke point, CHAINED
+    after any already-installed hook (both run; the first to raise
+    wins) — so flaky + slow + poison compose into one chaos scenario.
+    Exit restores exactly the previous hook."""
+    prev = resilience._serve_fault
+    if prev is None:
+        combined = hook
+    else:
+        def combined(requests):
+            prev(requests)
+            hook(requests)
+    resilience._serve_fault = combined
+    try:
+        yield
+    finally:
+        resilience._serve_fault = prev
+
+
+@contextlib.contextmanager
+def flaky_execute(times=1, exc_factory=None, match=None):
+    """Fail the first ``times`` serving dispatch attempts (every attempt
+    when ``times`` is None) with a TRANSIENT error (:class:`FaultInjected`
+    by default — an OSError, so the serving retry policy classifies it
+    retryable), optionally only for dispatches where ``match(requests)``
+    is true.  Retries and bisected sub-batches count as fresh attempts,
+    exactly like a real flaky device runtime.  Yields a one-item list
+    holding the number of faults fired so far."""
+    remaining = [None if times is None else int(times)]
+    fired = [0]
+    make_exc = exc_factory or (lambda requests: FaultInjected(
+        "injected transient execute fault (%d requests)" % len(requests)))
+
+    def hook(requests):
+        if match is not None and not match(requests):
+            return
+        if remaining[0] is not None:
+            if remaining[0] <= 0:
+                return
+            remaining[0] -= 1
+        fired[0] += 1
+        raise make_exc(requests)
+
+    with _serve_fault_installed(hook):
+        yield fired
+
+
+@contextlib.contextmanager
+def slow_execute(delay_s, times=None, match=None):
+    """Add ``delay_s`` seconds to every serving dispatch (the first
+    ``times`` when given) — the deterministic way to shrink an engine's
+    service rate so open-loop load tests overload it on any machine.
+    Yields a one-item list with the number of slowed dispatches."""
+    remaining = [None if times is None else int(times)]
+    fired = [0]
+    delay = float(delay_s)
+
+    def hook(requests):
+        if match is not None and not match(requests):
+            return
+        if remaining[0] is not None:
+            if remaining[0] <= 0:
+                return
+            remaining[0] -= 1
+        fired[0] += 1
+        time.sleep(delay)
+
+    with _serve_fault_installed(hook):
+        yield fired
+
+
+@contextlib.contextmanager
+def poison_request(is_poison, exc_factory=None):
+    """Make specific request(s) POISON: every dispatch attempt whose
+    batch contains a matching request fails FATALLY (``ValueError`` by
+    default — not transient, so retries don't help and the engine must
+    bisect to save the co-batched innocents).  ``is_poison`` is a
+    ``seq`` int, an iterable of seqs, or a callable ``(request) ->
+    bool``.  Yields a one-item list with the number of poisoned
+    dispatches."""
+    if callable(is_poison):
+        matches = is_poison
+    else:
+        seqs = (frozenset([int(is_poison)]) if np.isscalar(is_poison)
+                else frozenset(int(s) for s in is_poison))
+        matches = lambda r: r.seq in seqs  # noqa: E731
+    fired = [0]
+    make_exc = exc_factory or (lambda bad: ValueError(
+        "injected poison request (seq %s)"
+        % ", ".join(str(r.seq) for r in bad)))
+
+    def hook(requests):
+        bad = [r for r in requests if matches(r)]
+        if bad:
+            fired[0] += 1
+            raise make_exc(bad)
+
+    with _serve_fault_installed(hook):
+        yield fired
+
+
+@contextlib.contextmanager
+def kill_worker(at_dispatch=0):
+    """KILL the serving worker thread at the ``at_dispatch``-th dispatch
+    attempt (0-based, counted from context entry) by raising
+    :class:`WorkerKilled` — a ``BaseException`` nothing in the dispatch
+    path catches.  The thread dies silently (no stderr traceback; the
+    death lands on ``serving.worker_deaths``) and admitted requests
+    would hang forever — which is exactly what the engine's supervisor
+    must detect and repair.  Yields a one-item list with the dispatch
+    count so far."""
+    count = [0]
+    target = int(at_dispatch)
+
+    def hook(requests):
+        idx = count[0]
+        count[0] += 1
+        if idx == target:
+            raise WorkerKilled(
+                "injected worker kill at dispatch %d" % idx)
+
+    with _serve_fault_installed(hook):
+        yield count
+

@@ -1,0 +1,269 @@
+"""RequestQueue contracts under contention: priority lanes, per-class
+capacity, deadline-aware admission shedding, queue_full counter accuracy
+at capacity races, drain_remaining racing active get(), and FIFO /
+seq-watermark invariants with concurrent producers.
+
+These are the admission-edge guarantees the serving engine leans on; the
+end-to-end overload behavior is gated by tools/check_slo.py via
+test_slo_gate.py."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.serving.request_queue import PRIORITY_CLASSES, Request
+
+
+def _req(rows=1, deadline=None, priority=None):
+    return Request({"x": np.zeros((rows, 2), "float32")}, rows,
+                   deadline=deadline, priority=priority)
+
+
+# -- priority lanes ----------------------------------------------------------
+
+def test_priority_pop_order_fifo_within_class():
+    q = serving.RequestQueue(capacity=32)
+    be = [q.put(_req(priority="best_effort")) for _ in range(3)]
+    ba = [q.put(_req(priority="batch")) for _ in range(3)]
+    ia = [q.put(_req(priority="interactive")) for _ in range(3)]
+    popped = [q.get(timeout=0) for _ in range(9)]
+    assert popped[:3] == ia and popped[3:6] == ba and popped[6:] == be
+    # FIFO within each lane: admission seq strictly increasing per class
+    for lane in (popped[:3], popped[3:6], popped[6:]):
+        seqs = [r.seq for r in lane]
+        assert seqs == sorted(seqs)
+    # seq is globally monotone in ADMISSION order across lanes
+    assert sorted(r.seq for r in popped) == list(range(1, 10))
+
+
+def test_unknown_priority_rejected():
+    q = serving.RequestQueue(capacity=4)
+    with pytest.raises(serving.ServingError, match="priority"):
+        q.put(_req(priority="platinum"))
+
+
+def test_per_class_capacity_caps_one_lane_only():
+    q = serving.RequestQueue(capacity=8, class_capacity={"best_effort": 2})
+    q.put(_req(priority="best_effort"))
+    q.put(_req(priority="best_effort"))
+    with pytest.raises(serving.ServingQueueFull, match="best_effort"):
+        q.put(_req(priority="best_effort"))
+    # other lanes unaffected by the best_effort cap
+    for _ in range(5):
+        q.put(_req(priority="interactive"))
+    assert q.class_depths() == {"interactive": 5, "batch": 0,
+                                "best_effort": 2}
+
+
+def test_max_rows_filler_can_come_from_lower_lane():
+    q = serving.RequestQueue(capacity=8)
+    big = q.put(_req(rows=4, priority="interactive"))
+    small = q.put(_req(rows=1, priority="batch"))
+    # the interactive head doesn't fit under max_rows=2; the batch head
+    # does and rides as filler — no head-of-line block on the filler path
+    assert q.get(timeout=0, max_rows=2) is small
+    assert q.get(timeout=0, max_rows=4) is big
+
+
+def test_starvation_aging_pops_old_lower_lane_head():
+    q = serving.RequestQueue(capacity=16, starvation_s=0.05)
+    starved = q.put(_req(priority="best_effort"))
+    time.sleep(0.08)  # the best_effort head ages past the threshold
+    fresh = q.put(_req(priority="interactive"))
+    # aged lower-lane head wins over the fresher interactive arrival
+    assert q.get(timeout=0) is starved
+    assert q.get(timeout=0) is fresh
+    # aging disabled -> pure strict priority, starvation possible
+    q2 = serving.RequestQueue(capacity=16, starvation_s=None)
+    be = q2.put(_req(priority="best_effort"))
+    time.sleep(0.02)
+    ia = q2.put(_req(priority="interactive"))
+    assert q2.get(timeout=0) is ia
+    assert q2.get(timeout=0) is be
+
+
+# -- deadline-aware admission shedding ---------------------------------------
+
+def test_deadline_shed_at_admission_needs_warm_estimator():
+    q = serving.RequestQueue(capacity=32)
+    doomed_deadline = time.perf_counter() + 0.010
+    # cold estimator: never shed on deadline (warmup traffic must flow)
+    q.put(_req(deadline=doomed_deadline))
+    # warm it: 10 rows/s -> 1 queued row ahead = ~100ms estimated wait
+    q.note_service(rows=10, seconds=1.0)
+    assert q.service_rate == pytest.approx(10.0)
+    shed0 = obs.counter("serving.shed_admission").value
+    with pytest.raises(serving.ServingOverloaded, match="shed at admission"):
+        q.put(_req(deadline=time.perf_counter() + 0.010))
+    assert obs.counter("serving.shed_admission").value == shed0 + 1
+    # a deadline beyond the estimated wait is admitted
+    q.put(_req(deadline=time.perf_counter() + 10.0))
+    # higher-priority lanes only count rows at their own level or above:
+    # the backlog is all batch-class, so interactive sees less wait ahead
+    est_batch = q.estimated_wait_s("batch")
+    est_inter = q.estimated_wait_s("interactive")
+    assert est_inter < est_batch
+
+
+def test_estimated_wait_tracks_lane_rows():
+    q = serving.RequestQueue(capacity=32)
+    q.note_service(rows=100, seconds=1.0)  # 100 rows/s
+    q.put(_req(rows=10, priority="interactive"))
+    q.put(_req(rows=20, priority="batch"))
+    q.put(_req(rows=40, priority="best_effort"))
+    assert q.estimated_wait_s("interactive") == pytest.approx(0.10)
+    assert q.estimated_wait_s("batch") == pytest.approx(0.30)
+    assert q.estimated_wait_s("best_effort") == pytest.approx(0.70)
+
+
+# -- queue_full counter accuracy under capacity races ------------------------
+
+def test_queue_full_counter_accuracy_under_producer_race():
+    CAP, THREADS, PER = 16, 8, 40
+    q = serving.RequestQueue(capacity=CAP)
+    full0 = obs.counter("serving.queue_full").value
+    admitted, rejected = [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(THREADS)
+
+    def producer():
+        barrier.wait()
+        for _ in range(PER):
+            r = _req()
+            try:
+                q.put(r)
+            except serving.ServingQueueFull:
+                with lock:
+                    rejected.append(r)
+            else:
+                with lock:
+                    admitted.append(r)
+
+    threads = [threading.Thread(target=producer) for _ in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly CAP admissions (no consumer ran), every other put rejected
+    # AND counted — the counter can't over- or under-count on the race
+    assert len(admitted) == CAP and q.depth() == CAP
+    assert len(rejected) == THREADS * PER - CAP
+    assert (obs.counter("serving.queue_full").value - full0
+            == len(rejected))
+    # admitted seqs are exactly 1..CAP, no gaps, no duplicates
+    assert sorted(r.seq for r in admitted) == list(range(1, CAP + 1))
+    assert all(r.seq is None for r in rejected)
+
+
+# -- drain_remaining racing an active get() ----------------------------------
+
+def test_drain_remaining_races_get_exactly_one_owner():
+    N = 400
+    q = serving.RequestQueue(capacity=N)
+    reqs = [q.put(_req()) for _ in range(N)]
+    popped, stop = [], threading.Event()
+
+    def consumer():
+        while not stop.is_set() or q.depth():
+            r = q.get(timeout=0.001)
+            if r is not None:
+                popped.append(r)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.002)  # let the consumer pop mid-drain
+    failed = q.drain_remaining()
+    stop.set()
+    t.join(5)
+    assert not t.is_alive()
+    # every request has exactly one owner: popped XOR failed, none lost
+    popped_seqs = {r.seq for r in popped}
+    failed_reqs = [r for r in reqs if r.done()]
+    failed_seqs = {r.seq for r in failed_reqs}
+    assert len(popped) == len(popped_seqs)     # no double pop
+    assert popped_seqs.isdisjoint(failed_seqs)  # no double ownership
+    assert len(popped_seqs) + len(failed_seqs) == N
+    assert failed == len(failed_seqs)
+    assert q.depth() == 0
+    for r in failed_reqs:
+        with pytest.raises(serving.ServingClosed):
+            r.result(timeout=0)
+
+
+# -- FIFO / seq-watermark invariants under contention ------------------------
+
+def test_fifo_and_watermark_invariants_under_contention():
+    PRODUCERS, PER = 6, 50
+    q = serving.RequestQueue(capacity=PRODUCERS * PER)
+    rng = np.random.RandomState(0)
+    prios = [rng.choice(PRIORITY_CLASSES) for _ in range(PRODUCERS * PER)]
+    idx = [0]
+    lock = threading.Lock()
+
+    def producer():
+        while True:
+            with lock:
+                if idx[0] >= len(prios):
+                    return
+                p = prios[idx[0]]
+                idx[0] += 1
+            q.put(_req(priority=str(p)))
+
+    threads = [threading.Thread(target=producer) for _ in range(PRODUCERS)]
+    for t in threads:
+        t.start()
+    pop_order = []
+    while len(pop_order) < PRODUCERS * PER:
+        r = q.get(timeout=1.0)
+        if r is not None:
+            pop_order.append(r)
+    for t in threads:
+        t.join()
+    # seq watermark: last_seq equals total admissions; seqs are a
+    # permutation of 1..N (assigned under the lock, no gaps ever)
+    assert q.last_seq() == PRODUCERS * PER
+    assert sorted(r.seq for r in pop_order) == list(
+        range(1, PRODUCERS * PER + 1))
+    # FIFO within each priority lane even with racing producers
+    for cls in PRIORITY_CLASSES:
+        lane_seqs = [r.seq for r in pop_order if r.priority == cls]
+        assert lane_seqs == sorted(lane_seqs)
+
+
+# -- Request.result() deadline clamp (satellite fix) -------------------------
+
+def test_result_with_already_expired_deadline_reports_age_not_negative():
+    r = _req(deadline=time.perf_counter() - 0.5)  # expired before result()
+    r.enqueue_ts = time.perf_counter() - 1.0
+    r.seq = 7
+    t0 = time.perf_counter()
+    with pytest.raises(serving.ServingTimeout) as ei:
+        r.result()
+    # returns immediately (clamped wait, not a negative Event.wait arg)
+    assert time.perf_counter() - t0 < 0.25
+    msg = str(ei.value)
+    assert "deadline already expired" in msg
+    assert "-0." not in msg and "None" not in msg
+    # reports the request's actual age in the engine (~1s), clamped >= 0
+    age = float(msg.split("unanswered ")[1].split("s after")[0])
+    assert 0.5 <= age < 5.0
+
+
+def test_result_timeout_still_waits_and_reports():
+    r = _req()
+    r.enqueue_ts = time.perf_counter()
+    t0 = time.perf_counter()
+    with pytest.raises(serving.ServingTimeout):
+        r.result(timeout=0.05)
+    assert 0.04 <= time.perf_counter() - t0 < 1.0
+
+
+def test_done_ts_stamped_on_complete_and_fail():
+    a, b = _req(), _req()
+    assert a.done_ts is None
+    a.complete([np.zeros(2)])
+    b.fail(RuntimeError("x"))
+    assert a.done_ts is not None and b.done_ts is not None
