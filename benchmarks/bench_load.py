@@ -172,14 +172,24 @@ def build_schedule(process, rate, n, seed, capacity):
 
 def run_open_loop(engine, schedule, seed):
     """Submit the schedule open-loop; resolve everything; per-class
-    outcome table.  Returns (per_class dict, overall dict)."""
+    outcome table.  Returns (per_class dict, overall dict).
+
+    Latency quantiles come from the LIVE telemetry histograms
+    (``serving.request_latency_<class>``, snapshotted before/after the
+    leg and diffed) — the bench reports the same numbers a Prometheus
+    scrape of ``/metrics`` would show for the same window, by
+    construction, instead of a second sort-based percentile
+    implementation that could drift from it."""
+    from paddle_tpu import observability as obs
     from paddle_tpu import serving
 
     rng = np.random.RandomState(seed + 1)
     payloads = [rng.randn(1, WIDTH).astype(np.float32) for _ in range(128)]
     outcomes = []   # (cls, kind, latency_s or None, deadline_met)
     futs = []       # (idx, cls, deadline_ms, arrival_ts, fut)
-    lateness = []
+    lateness = []     # exact: not exported anywhere, so no histogram to match
+    lat_base = {cls: obs.histogram("serving.request_latency_%s" % cls)
+                .snapshot() for cls, _ in CLASS_MIX}
     t0 = time.perf_counter()
     for i, (dt, cls, deadline_ms) in enumerate(schedule):
         now = time.perf_counter() - t0
@@ -221,7 +231,6 @@ def run_open_loop(engine, schedule, seed):
     per_class = {}
     for cls, _ in CLASS_MIX:
         rows = [o for o in outcomes if o[0] == cls]
-        lat = sorted(o[2] for o in rows if o[2] is not None)
         kinds = {}
         for _, kind, _, _ in rows:
             kinds[kind] = kinds.get(kind, 0) + 1
@@ -238,9 +247,16 @@ def run_open_loop(engine, schedule, seed):
             "failed": kinds.get("failed", 0),
             "goodput": round(n_good / n_attempted, 4) if n_attempted else None,
         }
-        for q, name in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
-            entry[name] = (round(float(np.percentile(lat, q)) * 1e3, 2)
-                           if lat else None)
+        # windowed delta of the live per-class latency histogram: the
+        # same estimator (and usually the same observations) a live
+        # /metrics scrape reports for this leg
+        lat_delta = (obs.histogram("serving.request_latency_%s" % cls)
+                     .snapshot() - lat_base[cls])
+        for q, name in ((0.50, "p50_ms"), (0.95, "p95_ms"),
+                        (0.99, "p99_ms")):
+            v = lat_delta.quantile(q)
+            entry[name] = None if v is None else round(v * 1e3, 2)
+        entry["telemetry_latency_n"] = lat_delta.count
         per_class[cls] = entry
     overall = {
         "requests": len(schedule),

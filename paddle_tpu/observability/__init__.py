@@ -48,6 +48,8 @@ Usage::
 """
 from __future__ import annotations
 
+from .export import MetricsServer, prometheus_name, render_prometheus
+from .histogram import Histogram, HistogramSnapshot, default_bounds
 from .registry import (
     Counter,
     Gauge,
@@ -59,6 +61,7 @@ from .registry import (
     enabled,
     gauge,
     get_telemetry,
+    histogram,
     inc,
     observe,
     observe_span,
@@ -77,17 +80,23 @@ from .sinks import (
     StdoutSummarySink,
     print_report,
 )
+from .slo import SLOAlert, SLOMonitor, SLOTarget
+from .tracing import TraceContext, build_trace_tree, new_trace
 
 __all__ = [
     "Telemetry",
     "Counter",
     "Gauge",
     "Timer",
+    "Histogram",
+    "HistogramSnapshot",
+    "default_bounds",
     "get_telemetry",
     "enabled",
     "counter",
     "gauge",
     "timer",
+    "histogram",
     "inc",
     "observe",
     "span",
@@ -105,6 +114,15 @@ __all__ = [
     "ChromeTraceSink",
     "print_report",
     "STEP_SCHEMA",
+    "TraceContext",
+    "new_trace",
+    "build_trace_tree",
+    "MetricsServer",
+    "render_prometheus",
+    "prometheus_name",
+    "SLOMonitor",
+    "SLOTarget",
+    "SLOAlert",
 ]
 
 # The step-record schema every future perf/robustness PR reports into.

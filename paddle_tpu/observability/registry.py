@@ -25,10 +25,14 @@ import os
 import threading
 import time
 
+from .histogram import Histogram
+
 __all__ = [
     "Counter",
     "Gauge",
     "Timer",
+    "Histogram",
+    "histogram",
     "Telemetry",
     "get_telemetry",
     "enabled",
@@ -215,6 +219,7 @@ class Telemetry:
         self._counters = {}
         self._gauges = {}
         self._timers = {}
+        self._histograms = {}
         self._sinks = []
         # precomputed fast-path flags: one attribute read on the hot path
         self.recording = False      # enabled and >=1 sink takes records
@@ -263,6 +268,13 @@ class Telemetry:
                 t = self._timers.setdefault(name, Timer(name))
         return t
 
+    def histogram(self, name) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name))
+        return h
+
     def inc(self, name, n=1):
         self.counter(name).inc(n)
 
@@ -281,13 +293,18 @@ class Telemetry:
         with self._lock:
             return dict(self._timers)
 
+    def histograms(self):
+        with self._lock:
+            return dict(self._histograms)
+
     def reset(self, prefix=None):
         """Zero metrics IN PLACE (cached handles stay valid).  With a
         ``prefix``, only matching names reset — ``reset_profiler`` clears
         the profiler namespace without touching e.g. the executor's
         feed-copy contract counter."""
         with self._lock:
-            groups = (self._counters, self._gauges, self._timers)
+            groups = (self._counters, self._gauges, self._timers,
+                      self._histograms)
         for group in groups:
             for name, metric in list(group.items()):
                 if prefix is None or name.startswith(prefix):
@@ -399,6 +416,10 @@ def gauge(name) -> Gauge:
 
 def timer(name) -> Timer:
     return _global.timer(name)
+
+
+def histogram(name) -> Histogram:
+    return _global.histogram(name)
 
 
 def inc(name, n=1):

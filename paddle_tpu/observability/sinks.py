@@ -17,11 +17,14 @@ transfer thread(s) while the step loop emits records.
 """
 from __future__ import annotations
 
+import atexit
 import collections
 import json
+import os
 import sys
 import threading
 import time
+import weakref
 
 __all__ = [
     "Sink",
@@ -72,19 +75,55 @@ class Sink:
         return False
 
 
+# Every open JsonlSink, weakly held: an interpreter exiting mid-run
+# (batch job killed by its scheduler, notebook restart) must not lose
+# the buffered tail of a long serving log.  atexit flushes — it does
+# not close, because teardown-ordered emitters may still be writing.
+_LIVE_JSONL = weakref.WeakSet()
+
+
+@atexit.register
+def _flush_jsonl_sinks_at_exit():
+    for sink in list(_LIVE_JSONL):
+        try:
+            sink.flush()
+        except Exception:
+            pass
+
+
 class JsonlSink(Sink):
     """Append one JSON object per record to ``path``.
 
     Values that are not JSON-native (numpy scalars, device arrays handed
     in as metrics) are coerced via ``float``/``str`` fallback — a record
     must never raise out of the training loop.  Writes ride Python's
-    buffered file object; ``flush()``/``close()`` make them durable."""
+    buffered file object; ``flush()``/``close()`` make them durable, and
+    every live sink is flushed once more at interpreter exit.
 
-    def __init__(self, path):
+    ``max_bytes`` enables size-based rotation: when the current file
+    grows past it, it is renamed to ``path.1`` (shifting ``path.1`` ->
+    ``path.2`` ... up to ``max_files`` rotated files, oldest dropped)
+    and a fresh file is opened — a long serving run keeps a bounded
+    window of telemetry instead of one unbounded file.  Rotation happens
+    at a record boundary, so every file is independently parseable.
+
+    ``spans=True`` additionally subscribes the sink to trace spans,
+    written as ``{"type": "span", name, ts, dur, thread, tags}`` lines —
+    the offline half of request-scoped tracing
+    (:mod:`~paddle_tpu.observability.tracing`)."""
+
+    def __init__(self, path, max_bytes=None, max_files=5, spans=False):
         self.path = path
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        self.max_files = max(1, int(max_files))
+        self.wants_spans = bool(spans)
         self._lock = threading.Lock()
         self._f = open(path, "a", buffering=1024 * 64)
+        self._written = self._f.tell()   # "a" mode: position == size
+        self._next_rotate_at = self.max_bytes
         self.emitted = 0
+        self.rotations = 0
+        _LIVE_JSONL.add(self)
 
     @staticmethod
     def _default(obj):
@@ -93,14 +132,53 @@ class JsonlSink(Sink):
         except (TypeError, ValueError):
             return str(obj)
 
+    def _write_locked(self, line):
+        if self._f is None:
+            return
+        self._f.write(line + "\n")
+        self._written += len(line) + 1
+        self.emitted += 1
+        if self.max_bytes is not None and self._written >= self._next_rotate_at:
+            self._rotate_locked()
+
+    def _rotate_locked(self):
+        self._f.flush()
+        self._f.close()
+        try:
+            for i in range(self.max_files - 1, 0, -1):
+                src = "%s.%d" % (self.path, i)
+                if os.path.exists(src):
+                    os.replace(src, "%s.%d" % (self.path, i + 1))
+            os.replace(self.path, self.path + ".1")
+            rotated = True
+        except OSError:
+            # rotation is best-effort (read-only dir race, NFS quirks):
+            # keep appending to the current file rather than losing data
+            rotated = False
+        self._f = open(self.path, "a", buffering=1024 * 64)
+        self._written = self._f.tell()
+        if rotated:
+            self.rotations += 1
+            self._next_rotate_at = self.max_bytes
+        else:
+            # back off: retry after ANOTHER max_bytes accumulates, not
+            # on every record — a denied rename must not turn the
+            # logging path into per-record close/rename/reopen churn
+            self._next_rotate_at = self._written + self.max_bytes
+
     def emit(self, record):
         line = json.dumps(record, default=self._default,
                           separators=(",", ":"))
         with self._lock:
-            if self._f is None:
-                return
-            self._f.write(line + "\n")
-            self.emitted += 1
+            self._write_locked(line)
+
+    def emit_span(self, name, ts, dur, thread, tags):
+        line = json.dumps(
+            {"type": "span", "name": name, "ts": ts, "dur": dur,
+             "thread": thread.name, "tags": tags},
+            default=self._default, separators=(",", ":"))
+        with self._lock:
+            self._write_locked(line)
 
     def flush(self):
         with self._lock:
@@ -228,17 +306,25 @@ class ChromeTraceSink(Sink):
         self.record_steps = record_steps
         self._lock = threading.Lock()
         self._events = []
-        self._tids = {}
+        self._tids = {}          # ident -> (thread object, tid)
+        self._n_tids = 0
         self._closed = False
 
     def _tid(self, thread):
-        tid = self._tids.get(thread.ident)
-        if tid is None:
-            tid = self._tids[thread.ident] = len(self._tids) + 1
-            self._events.append({
-                "name": "thread_name", "ph": "M", "pid": self.pid,
-                "tid": tid, "args": {"name": thread.name},
-            })
+        entry = self._tids.get(thread.ident)
+        if entry is not None and entry[0] is thread:
+            return entry[1]
+        # first sighting — or an IDENT REUSE: the OS recycles thread ids
+        # once a thread exits, so a fresh thread (say a restarted serving
+        # worker) can reappear under a dead thread's ident.  It must get
+        # its own track and name, not inherit the dead thread's slices.
+        self._n_tids += 1
+        tid = self._n_tids
+        self._tids[thread.ident] = (thread, tid)
+        self._events.append({
+            "name": "thread_name", "ph": "M", "pid": self.pid,
+            "tid": tid, "args": {"name": thread.name},
+        })
         return tid
 
     def emit_span(self, name, ts, dur, thread, tags):

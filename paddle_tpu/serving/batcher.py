@@ -48,12 +48,13 @@ import time
 
 from .. import observability as _obs
 from .errors import ServingClosed, ServingDegraded, ServingTimeout
+from .worker import RestartableWorker
 
 __all__ = ["DynamicBatcher"]
 
 _expired = _obs.counter("serving.expired")
 _queue_wait = _obs.timer("serving.queue_wait")
-_worker_deaths = _obs.counter("serving.worker_deaths")
+_queue_wait_hist = _obs.histogram("serving.queue_wait")
 
 
 class DynamicBatcher:
@@ -72,58 +73,41 @@ class DynamicBatcher:
         self._execute = execute
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_s)
-        self._name = name
-        self._stop = False
         self._drain = True
-        self.started = False
         self._done_lock = threading.Lock()
         self._done_cond = threading.Condition(self._done_lock)
         self.completed_seq = 0
         self._done_seqs = set()        # completed seqs above the watermark
         self.batches = 0
         self._inflight = None          # batch being dispatched right now
-        # serializes start/restart: a supervisor restart tick and an
-        # operator start() must not race a thread spawn into two workers
-        self._life_lock = threading.Lock()
-        self._thread = threading.Thread(target=self._run, name=name,
-                                        daemon=True)
+        # thread lifecycle (single-use Thread re-arming, life lock
+        # against start/restart races, BaseException death choke) lives
+        # in the shared RestartableWorker — see worker.py
+        self._worker = RestartableWorker(self._serve_loop, name,
+                                         on_death=self._fail_inflight,
+                                         label="batcher")
 
     def start(self):
-        with self._life_lock:
-            if self._thread.is_alive():
-                return self
-            if self.started:
-                # the worker already ran and died: Thread objects are
-                # single-use, so re-arm via restart() instead of raising
-                # RuntimeError on a dead thread (no-op while stopping)
-                self._restart_locked()
-                return self
-            self.started = True
-            self._thread.start()
+        self._worker.start()
         return self
 
     def restart(self):
         """Re-arm a DEAD worker with a fresh thread (the supervisor's
         recovery path); queue, watermark, and batch counts carry over.
         No-op (False) while stopping or still alive."""
-        with self._life_lock:
-            return self._restart_locked()
+        return self._worker.restart()
 
-    def _restart_locked(self):
-        if self._stop or self._thread.is_alive():
-            return False
-        self._thread = threading.Thread(target=self._run, name=self._name,
-                                        daemon=True)
-        self._thread.start()
-        return True
+    @property
+    def started(self):
+        return self._worker.started
 
     @property
     def alive(self):
-        return self._thread.is_alive()
+        return self._worker.alive
 
     @property
     def stopping(self):
-        return self._stop
+        return self._worker.stopping
 
     # -- drain watermark -----------------------------------------------------
     def _mark_done(self, requests):
@@ -162,42 +146,31 @@ class DynamicBatcher:
                 continue
             return req
 
-    def _run(self):
-        try:
-            self._serve_loop()
-        except BaseException:  # noqa: BLE001 — the silent-death choke point
-            # The worker is dying (chaos kill_worker, interpreter
-            # teardown, or a genuinely unexpected escape).  Count it so
-            # the death is observable, fail the batch it died holding —
-            # those requests are in neither the queue nor a terminal
-            # state, and nobody else will ever touch them — then let the
-            # thread end: the supervisor restarts it or fails pending
-            # requests fast.
-            _worker_deaths.inc()
-            inflight, self._inflight = self._inflight, None
-            if inflight:
-                for r in inflight:
-                    if not r.done():
-                        r.fail(ServingDegraded(
-                            "serving worker died mid-dispatch; request "
-                            "aborted"))
-                self._mark_done(inflight)
-            tel = _obs.get_telemetry()
-            if tel.recording:
-                tel.emit({"type": "worker_death", "ts": time.time(),
-                          "source": "serving", "worker": self._name})
+    def _fail_inflight(self):
+        """Death cleanup (runs inside the worker's BaseException choke):
+        fail the batch the worker died holding — those requests are in
+        neither the queue nor a terminal state, and nobody else will
+        ever touch them."""
+        inflight, self._inflight = self._inflight, None
+        if inflight:
+            for r in inflight:
+                if not r.done():
+                    r.fail(ServingDegraded(
+                        "serving worker died mid-dispatch; request "
+                        "aborted"))
+            self._mark_done(inflight)
 
     def _serve_loop(self):
         while True:
-            if self._stop and not self._drain:
+            if self._worker.stopping and not self._drain:
                 # non-drain stop: exit after the in-flight batch instead
                 # of serving the backlog — stop() fails the leftovers
                 # via drain_remaining once the thread is gone
                 return
             head = self._pop_live(timeout=0.05, max_rows=None)
             if head is None:
-                if self._stop and (not self._drain
-                                   or self._queue.depth() == 0):
+                if self._worker.stopping and (not self._drain
+                                              or self._queue.depth() == 0):
                     return
                 continue
             batch = [head]
@@ -214,9 +187,21 @@ class DynamicBatcher:
                 batch.append(nxt)
                 rows += nxt.rows
             now = time.perf_counter()
+            wall_now = time.time()
+            tel = _obs.get_telemetry()
+            spans = tel.span_active()
             for r in batch:
                 r.dispatch_ts = now
-                _queue_wait.observe(now - r.enqueue_ts)
+                wait = now - r.enqueue_ts
+                _queue_wait.observe(wait)
+                _queue_wait_hist.observe(wait)
+                if spans and r.trace is not None:
+                    # the queue-wait leg of the request's trace tree,
+                    # parented under its admission root
+                    tel.record_span(
+                        "serving.queue_wait", r.enqueue_wall, wait,
+                        tags=r.trace.child().tags(priority=r.priority,
+                                                  seq=r.seq))
             self._inflight = batch
             try:
                 self._execute(batch)
@@ -230,6 +215,16 @@ class DynamicBatcher:
             note = getattr(self._queue, "note_service", None)
             if note is not None:
                 note(rows, elapsed)
+            if spans:
+                for r in batch:
+                    if r.trace is not None:
+                        # batch membership: how long this request's
+                        # coalesced dispatch (incl. retries/bisection)
+                        # held the worker, and with whom
+                        tel.record_span(
+                            "serving.batch", wall_now, elapsed,
+                            tags=r.trace.child().tags(
+                                rows=rows, requests=len(batch)))
             self._mark_done(batch)
             self._inflight = None
             self.batches += 1
@@ -242,10 +237,8 @@ class DynamicBatcher:
         dead, it never started, drain was off, or the join timed out —
         are failed via ``drain_remaining`` instead of left hanging."""
         self._drain = bool(drain)
-        self._stop = True
-        if self._thread.is_alive():
-            self._thread.join(timeout)
-        stopped = not self._thread.is_alive()
+        self._worker.request_stop()
+        stopped = self._worker.join(timeout)
         if self._queue.depth() and (stopped or timeout is not None):
             # nothing will ever pop these (dead/wedged worker): fail fast.
             # A wedged-but-alive worker popping concurrently is safe —

@@ -54,6 +54,7 @@ from .errors import (
 )
 from .kv_cache import PagedKVCache, write_prompt_kv
 from .request_queue import Request, RequestQueue
+from .worker import RestartableWorker
 
 __all__ = ["DecodeModel", "DecodeConfig", "GenerateRequest",
            "DecodeScheduler"]
@@ -65,13 +66,19 @@ _steps = _obs.counter("serving.decode.steps")
 _retired = _obs.counter("serving.decode.retired")
 _expired = _obs.counter("serving.decode.expired")
 _expired_mid_decode = _obs.counter("serving.decode.expired_mid_decode")
-_worker_deaths = _obs.counter("serving.worker_deaths")
 _queue_full = _obs.counter("serving.decode.queue_full")
 _queue_depth = _obs.gauge("serving.decode.queue_depth")
 _active_slots = _obs.gauge("serving.decode.active_slots")
 _prefill_timer = _obs.timer("serving.decode.prefill_step")
 _decode_timer = _obs.timer("serving.decode.decode_step")
 _queue_wait = _obs.timer("serving.decode.queue_wait")
+# tail-latency histograms (log-bucketed, SLO-grade quantiles): decode
+# queue wait, time-to-first-token (admission -> first sampled token, the
+# interactive-latency number), and per-iteration decode step time (the
+# inter-token-latency distribution)
+_queue_wait_hist = _obs.histogram("serving.decode.queue_wait")
+_ttft_hist = _obs.histogram("serving.decode.ttft")
+_step_hist = _obs.histogram("serving.decode.step")
 
 
 class DecodeModel:
@@ -243,7 +250,6 @@ class DecodeScheduler:
         # and a stop() that timed out joining a wedged-but-alive worker
         # — an unsynchronized claim could fail AND decode one request
         self._hol_lock = threading.Lock()
-        self._stop = False
         self._drain = True
         self._completed = 0
         self._retired_total = 0        # SERVED slot retirements only: the
@@ -251,13 +257,12 @@ class DecodeScheduler:
         # sheds, or fault mass-retires as served work, or overload and
         # failure inflate the rate and disable shed-at-admission exactly
         # when it matters
-        self.started = False
-        # serializes start/restart/fail_pending: a supervisor give-up
-        # tick and an operator start() must not race a thread spawn
-        # into a double worker or a _fail_all under a live worker
-        self._life_lock = threading.Lock()
-        self._thread = threading.Thread(
-            target=self._run, name="paddle-tpu-decode-scheduler", daemon=True)
+        # thread lifecycle (single-use Thread re-arming, life lock
+        # against start/restart/fail_pending races, BaseException death
+        # choke) lives in the shared RestartableWorker — see worker.py
+        self._worker = RestartableWorker(self._serve_loop,
+                                         "paddle-tpu-decode-scheduler",
+                                         label="decoder")
         if cfg.warmup:
             self.warmup()
         if autostart:
@@ -314,17 +319,7 @@ class DecodeScheduler:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
-        with self._life_lock:
-            if self._thread.is_alive() or self._stop:
-                return self
-            if self.started:
-                # the worker already ran and died: Thread objects are
-                # single-use, so re-arm via restart() instead of raising
-                # RuntimeError on a dead thread
-                self._restart_locked()
-                return self
-            self.started = True
-            self._thread.start()
+        self._worker.start()
         return self
 
     def restart(self):
@@ -332,24 +327,19 @@ class DecodeScheduler:
         recovery path); queue, slots, and KV state carry over — a kill
         lands between state updates, so resuming the loop continues
         every live sequence.  No-op (False) while stopping or alive."""
-        with self._life_lock:
-            return self._restart_locked()
+        return self._worker.restart()
 
-    def _restart_locked(self):
-        if self._stop or self._thread.is_alive():
-            return False
-        self._thread = threading.Thread(
-            target=self._run, name="paddle-tpu-decode-scheduler", daemon=True)
-        self._thread.start()
-        return True
+    @property
+    def started(self):
+        return self._worker.started
 
     @property
     def alive(self):
-        return self._thread.is_alive()
+        return self._worker.alive
 
     @property
     def stopping(self):
-        return self._stop
+        return self._worker.stopping
 
     def fail_pending(self, exc):
         """Fail every queued and active request with ``exc`` — the
@@ -359,10 +349,10 @@ class DecodeScheduler:
         trusting the caller: a supervisor give-up tick racing an
         operator ``engine.start()`` revive must not free pages under a
         live worker (returns False; the next tick sees the live thread
-        and skips).  The life lock serializes the aliveness check with
-        any concurrent restart/start spawn."""
-        with self._life_lock:
-            if self._thread.is_alive():
+        and skips).  The worker's life lock serializes the aliveness
+        check with any concurrent restart/start spawn."""
+        with self._worker.life_lock:
+            if self._worker.alive:
                 return False
             self._fail_all(exc)
         return True
@@ -373,21 +363,19 @@ class DecodeScheduler:
         ``ServingClosed`` after the in-flight iteration.  A worker that
         is still wedged when the join times out gets its QUEUED requests
         failed fast (the queue is lock-safe to drain; active slots stay
-        worker-owned — if the worker ever resumes it sees ``_stop`` and
-        fails them itself)."""
+        worker-owned — if the worker ever resumes it sees ``stopping``
+        and fails them itself)."""
         self._drain = bool(drain)
-        self._stop = True
+        self._worker.request_stop()
         self._queue.close()
-        if self._thread.is_alive():
-            self._thread.join(timeout)
-        stopped = not self._thread.is_alive()
+        stopped = self._worker.join(timeout)
         if stopped:
             # leftovers exist only when the worker never ran (or was
             # asked not to drain): fail them rather than hang futures.
             # Under the life lock: a supervisor give-up tick's
             # fail_pending must not race this into double-retiring a
             # slot (double cache.free would alias KV pages)
-            with self._life_lock:
+            with self._worker.life_lock:
                 self._fail_all(ServingClosed("decode scheduler stopped"))
         elif timeout is not None:
             # the head-of-line request parked awaiting KV pages is in
@@ -501,21 +489,11 @@ class DecodeScheduler:
             if slot is not None:
                 self._retire(i, error=exc)
 
-    def _run(self):
-        try:
-            self._serve_loop()
-        except BaseException:  # noqa: BLE001 — the silent-death choke point
-            # chaos kill_worker / interpreter teardown: count the death
-            # so it is observable, then let the thread end — the
-            # supervisor restarts it (slots and KV carry over) or fails
-            # pending requests fast.
-            _worker_deaths.inc()
-            tel = self._telemetry
-            if tel.recording:
-                tel.emit({"type": "worker_death", "ts": time.time(),
-                          "source": "serving", "worker": "decoder"})
-
     def _serve_loop(self):
+        # (BaseException escaping this loop is the death path: the
+        # RestartableWorker choke counts it, emits the worker_death
+        # record/trace event, and the supervisor restarts the thread —
+        # slots and KV carry over — or fails pending requests fast.)
         # anchors for the queue's service-rate EMA (deadline-aware
         # admission): retirements per second of BUSY wall time
         self._note_ts = time.perf_counter()
@@ -523,7 +501,7 @@ class DecodeScheduler:
         while True:
             self._admit()
             if self._active_count():
-                if self._stop and not self._drain:
+                if self._worker.stopping and not self._drain:
                     # non-drain stop: fail the actives after the
                     # in-flight iteration instead of decoding every
                     # sequence to completion (unbounded shutdown)
@@ -535,9 +513,9 @@ class DecodeScheduler:
             # idle: re-anchor so idle gaps don't dilute the rate
             self._note_ts = time.perf_counter()
             self._note_retired = self._retired_total
-            if self._stop and (not self._drain
-                               or (self._queue.depth() == 0
-                                   and self._hol is None)):
+            if self._worker.stopping and (not self._drain
+                                          or (self._queue.depth() == 0
+                                              and self._hol is None)):
                 if not self._drain:
                     self._fail_all(ServingClosed("decode scheduler stopped"))
                 return
@@ -563,7 +541,7 @@ class DecodeScheduler:
         idle so the loop doesn't spin."""
         cache, cfg = self._cache, self.config
         while self._active_count() < cfg.max_active:
-            if self._stop and not self._drain:
+            if self._worker.stopping and not self._drain:
                 return
             req = self._take_hol()
             if req is None:
@@ -609,12 +587,21 @@ class DecodeScheduler:
         page_vec[:n_prompt_pages] = pages[:n_prompt_pages]
         fn = self._jit.get(("prefill", bucket))
         now = time.perf_counter()
-        _queue_wait.observe(now - req.enqueue_ts)
+        wait = now - req.enqueue_ts
+        _queue_wait.observe(wait)
+        _queue_wait_hist.observe(wait)
         req.dispatch_ts = now
+        tel = self._telemetry
+        if tel.span_active() and req.trace is not None:
+            tel.record_span(
+                "serving.queue_wait", req.enqueue_wall, wait,
+                tags=req.trace.child().tags(priority=req.priority,
+                                            seq=req.seq))
         try:
             serve_fault = _resilience._serve_fault
             if serve_fault is not None:
                 serve_fault([req])
+            prefill_wall = time.time()
             with self._telemetry.timed("serving.decode.prefill",
                                        bucket=bucket, rows=req.prompt_len,
                                        seq=req.seq):
@@ -640,7 +627,16 @@ class DecodeScheduler:
             req.fail(ServingDegraded(
                 "decode worker died mid-prefill; request aborted"))
             raise
-        _prefill_timer.observe(time.perf_counter() - now)
+        done = time.perf_counter()
+        _prefill_timer.observe(done - now)
+        # TTFT: admission -> first sampled token, the number an
+        # interactive-decode SLO is written against
+        _ttft_hist.observe(done - req.enqueue_ts)
+        if tel.span_active() and req.trace is not None:
+            tel.record_span(
+                "serving.execute", prefill_wall, done - now,
+                tags=req.trace.child().tags(phase="prefill", bucket=bucket,
+                                            rows=req.prompt_len))
         self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
         slot = _Slot(req, pages)
         slot.generated.append(first)
@@ -709,7 +705,9 @@ class DecodeScheduler:
                 self._retire(i, error=exc)
             self._recover_pools(exc)
             return
-        _decode_timer.observe(time.perf_counter() - t0)
+        step_s = time.perf_counter() - t0
+        _decode_timer.observe(step_s)
+        _step_hist.observe(step_s)
         self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
         now = time.perf_counter()
         for i, slot in active:
@@ -747,12 +745,14 @@ class DecodeScheduler:
         _active_slots.set(self._active_count())
         tel = self._telemetry
         if tel.span_active():
+            seq_tags = {"seq": req.seq, "prompt": slot.prompt_len,
+                        "generated": len(slot.generated),
+                        "shed": error is not None}
+            if req.trace is not None:
+                seq_tags = req.trace.child().tags(**seq_tags)
             tel.record_span(
                 "serving.decode.sequence", req.enqueue_wall,
-                time.time() - req.enqueue_wall,
-                tags={"seq": req.seq, "prompt": slot.prompt_len,
-                      "generated": len(slot.generated),
-                      "shed": error is not None})
+                time.time() - req.enqueue_wall, tags=seq_tags)
         if tel.recording:
             tel.emit({
                 "type": "decode_sequence", "ts": time.time(),

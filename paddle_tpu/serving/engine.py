@@ -64,6 +64,7 @@ _batches = _obs.counter("serving.batches")
 _batched_rows = _obs.counter("serving.batched_rows")
 _padded_rows = _obs.counter("serving.padded_rows")
 _swaps = _obs.counter("serving.swaps")
+_execute_hist = _obs.histogram("serving.execute")
 
 
 class InferenceEngine:
@@ -215,6 +216,7 @@ class InferenceEngine:
         self._bucket_counters = {
             b: _obs.counter("serving.batch_bucket_%d" % b)
             for b in self.batch_buckets}
+        self._metrics_server = None   # started only by serve_metrics()
         self._state = "ready"
         if autostart:
             self.start()
@@ -274,6 +276,9 @@ class InferenceEngine:
             # batch
             if worker_done and self._model is not None:
                 self._model.close()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+                self._metrics_server = None
 
     def __enter__(self):
         return self
@@ -335,6 +340,7 @@ class InferenceEngine:
             "queue_depth": self._queue.depth(),
             "queue_capacity": self._queue.capacity,
             "class_depths": self._queue.class_depths(),
+            "class_rows": self._queue.class_rows(),
             "service_rate_rows_per_s": self._queue.service_rate,
             # worker liveness: False means admitted requests would hang
             # without the supervisor — surface it so orchestrators see a
@@ -353,6 +359,25 @@ class InferenceEngine:
         if self._decoder is not None:
             h["decode"] = self._decoder.stats()
         return h
+
+    def serve_metrics(self, host="127.0.0.1", port=0):
+        """Start (or return the already-running) live export endpoint for
+        THIS engine: ``GET /metrics`` is the Prometheus text exposition
+        of every registry cell (histogram bucket ladders included) and
+        ``GET /healthz`` is :meth:`health` as JSON, answering 503 while
+        :meth:`ready` is False — one endpoint doubles as scrape target
+        and load-balancer readiness probe.  OFF by default: nothing in
+        the engine opens a port unless an operator calls this.  Stops
+        with the engine (:meth:`stop`) or explicitly via the returned
+        :class:`~paddle_tpu.observability.MetricsServer`'s ``stop()``;
+        calling this again after a stop opens a fresh endpoint at the
+        newly requested host/port."""
+        srv = self._metrics_server
+        if srv is not None and srv.running:
+            return srv
+        self._metrics_server = _obs.MetricsServer(
+            host=host, port=port, health_fn=self.health).start()
+        return self._metrics_server
 
     @property
     def model_version(self):
@@ -500,10 +525,13 @@ class InferenceEngine:
                 return b
         return self.batch_buckets[-1]
 
-    def _dispatch_chunk(self, model, feed_full, lo, hi, n_requests):
+    def _dispatch_chunk(self, model, feed_full, lo, hi, chunk_requests):
         """Run rows [lo, hi) of the concatenated batch as one padded
-        bucket dispatch; returns ``(outs, batched_flags)``."""
+        bucket dispatch; returns ``(outs, batched_flags)``.
+        ``chunk_requests`` are the requests with rows in [lo, hi) — the
+        traces this dispatch is attributed to."""
         n = hi - lo
+        n_requests = len(chunk_requests)
         bucket = self._bucket_for(n)
         pad = bucket - n
         feed = {}
@@ -519,9 +547,22 @@ class InferenceEngine:
                     axis=0)
             feed[name] = chunk
         tel = self._telemetry
+        wall0, t0 = time.time(), time.perf_counter()
         with tel.timed("serving.execute", bucket=bucket, rows=n,
                        requests=n_requests, version=model.version):
             outs = model.predict_batch(feed)
+        exec_s = time.perf_counter() - t0
+        _execute_hist.observe(exec_s)
+        if tel.span_active():
+            # attribute THIS dispatch to every trace riding in it: the
+            # "execute" leaf of each request's tree (a retried dispatch
+            # emits one leaf per attempt that reached the model)
+            for r in chunk_requests:
+                if r.trace is not None:
+                    tel.record_span(
+                        "serving.execute", wall0, exec_s,
+                        tags=r.trace.child().tags(bucket=bucket, rows=n,
+                                                  version=model.version))
         _batches.inc()
         _batched_rows.inc(n)
         _padded_rows.inc(pad)
@@ -566,7 +607,7 @@ class InferenceEngine:
         cap = self.batch_buckets[-1]
         if rows <= cap:
             outs, flags = self._dispatch_chunk(model, feed_full, 0, rows,
-                                               len(requests))
+                                               requests)
         else:
             # an oversized coalesced batch (max_batch_size above the
             # largest bucket, or oversized direct queue use) is CHUNKED
@@ -575,13 +616,15 @@ class InferenceEngine:
             # reassembled below exactly as in the single-dispatch case
             bounds = [(lo, min(lo + cap, rows))
                       for lo in range(0, rows, cap)]
+            spans_by_req = self._request_spans(requests)
             per_chunk = []
             flags = None
             for lo, hi in bounds:
-                n_req = sum(1 for r_lo, r_hi in self._request_spans(requests)
-                            if r_lo < hi and r_hi > lo)
+                chunk_reqs = [r for r, (r_lo, r_hi)
+                              in zip(requests, spans_by_req)
+                              if r_lo < hi and r_hi > lo]
                 outs_c, flags_c = self._dispatch_chunk(model, feed_full,
-                                                       lo, hi, n_req)
+                                                       lo, hi, chunk_reqs)
                 per_chunk.append((outs_c, flags_c, hi - lo))
                 flags = flags_c if flags is None else flags
             outs = []
@@ -595,8 +638,6 @@ class InferenceEngine:
                     # computes its own; share the first chunk's verbatim
                     outs.append(per_chunk[0][0][j])
         offset = 0
-        done_wall = time.time()
-        spans = tel.span_active()
         for r in requests:
             result = []
             for j, a in enumerate(outs):
@@ -608,12 +649,9 @@ class InferenceEngine:
                 else:
                     result.append(a)
             offset += r.rows
+            # complete() emits the request's ROOT trace span and the
+            # per-class latency/goodput accounting (request_queue)
             r.complete(result)
-            if spans:
-                tel.record_span(
-                    "serving.request", r.enqueue_wall,
-                    done_wall - r.enqueue_wall,
-                    tags={"rows": r.rows, "seq": r.seq})
 
     @staticmethod
     def _request_spans(requests):

@@ -42,6 +42,7 @@ import threading
 import time
 
 from .. import observability as _obs
+from ..observability import tracing as _tracing
 from .errors import (
     ServingClosed,
     ServingError,
@@ -61,6 +62,27 @@ _queue_depth = _obs.gauge("serving.queue_depth")
 _queue_full = _obs.counter("serving.queue_full")
 _shed_admission = _obs.counter("serving.shed_admission")
 
+# Per-class completion accounting: the cells the SLO monitor windows
+# over (counter deltas + histogram snapshot subtraction) and the export
+# plane serves.  They live at the Request.complete/fail choke point —
+# the one funnel EVERY admitted request's terminal outcome passes
+# through (engine completion, batcher shed, dispatcher bisection,
+# decode retire, drain_remaining) — so goodput accounting can't miss a
+# path.  Like every counter, they always count (reading an SLO must not
+# require a sink).
+_done_counters = {}
+_done_ok_counters = {}
+_met_counters = {}
+_rejected_counters = {}
+_latency_hists = {}
+for _cls in ("interactive", "batch", "best_effort"):
+    _done_counters[_cls] = _obs.counter("serving.done_%s" % _cls)
+    _done_ok_counters[_cls] = _obs.counter("serving.done_ok_%s" % _cls)
+    _met_counters[_cls] = _obs.counter("serving.deadline_met_%s" % _cls)
+    _rejected_counters[_cls] = _obs.counter("serving.rejected_%s" % _cls)
+    _latency_hists[_cls] = _obs.histogram("serving.request_latency_%s" % _cls)
+del _cls
+
 
 class Request:
     """One admitted prediction request; doubles as the caller's future.
@@ -76,16 +98,17 @@ class Request:
     without polling.
     """
 
-    __slots__ = ("feed", "rows", "seq", "deadline", "priority",
+    __slots__ = ("feed", "rows", "seq", "deadline", "priority", "trace",
                  "enqueue_wall", "enqueue_ts", "dispatch_ts", "done_ts",
-                 "_event", "_result", "_error")
+                 "_event", "_result", "_error", "_term_lock")
 
-    def __init__(self, feed, rows, deadline=None, priority=None):
+    def __init__(self, feed, rows, deadline=None, priority=None, trace=None):
         self.feed = feed
         self.rows = int(rows)
         self.seq = None              # assigned by RequestQueue.put
         self.deadline = deadline     # absolute time.perf_counter() instant
         self.priority = priority or DEFAULT_PRIORITY
+        self.trace = trace           # TraceContext root; minted at admission
         self.enqueue_wall = None     # wall clock, for trace spans
         self.enqueue_ts = None       # perf_counter, for queue-wait timing
         self.dispatch_ts = None
@@ -93,6 +116,10 @@ class Request:
         self._event = threading.Event()
         self._result = None
         self._error = None
+        # serializes the terminal-outcome claim: complete() racing
+        # fail() (a revived worker finishing a request the same instant
+        # stop()'s drain fails it) must account exactly one outcome
+        self._term_lock = threading.Lock()
 
     # -- batcher side --------------------------------------------------------
     def expired(self, now=None):
@@ -101,14 +128,47 @@ class Request:
                 > self.deadline)
 
     def complete(self, result):
-        self._result = result
-        self.done_ts = time.perf_counter()
-        self._event.set()
+        with self._term_lock:
+            if self._event.is_set():
+                return           # first terminal outcome wins
+            self._result = result
+            self.done_ts = time.perf_counter()
+            self._note_done(ok=True)
+            self._event.set()
 
     def fail(self, exc):
-        self._error = exc
-        self.done_ts = time.perf_counter()
-        self._event.set()
+        with self._term_lock:
+            if self._event.is_set():
+                return           # first terminal outcome wins
+            self._error = exc
+            self.done_ts = time.perf_counter()
+            self._note_done(ok=False)
+            self._event.set()
+
+    def _note_done(self, ok):
+        """Terminal-outcome accounting: per-class done/ok/deadline-met
+        counters, the end-to-end latency histogram (answered requests),
+        and — when a span sink is attached — the request's ROOT trace
+        span, covering admission to terminal outcome."""
+        cls = self.priority if self.priority in _done_counters \
+            else DEFAULT_PRIORITY
+        _done_counters[cls].inc()
+        latency = (self.done_ts - self.enqueue_ts
+                   if self.enqueue_ts is not None else None)
+        if ok:
+            _done_ok_counters[cls].inc()
+            if latency is not None:
+                _latency_hists[cls].observe(latency)
+            if self.deadline is None or self.done_ts <= self.deadline:
+                _met_counters[cls].inc()
+        tel = _obs.get_telemetry()
+        if (tel.span_active() and self.trace is not None
+                and self.enqueue_wall is not None):
+            tel.record_span(
+                "serving.request", self.enqueue_wall,
+                latency if latency is not None else 0.0,
+                tags=self.trace.tags(seq=self.seq, rows=self.rows,
+                                     priority=cls, ok=ok))
 
     # -- caller side ---------------------------------------------------------
     def done(self):
@@ -253,11 +313,13 @@ class RequestQueue:
             lane = self._lanes[cls]
             if self._depth >= self.capacity:
                 self._full_counter.inc()
+                _rejected_counters[cls].inc()
                 raise ServingQueueFull(
                     "request queue at capacity (%d); shed load or retry"
                     % self.capacity)
             if len(lane) >= self.class_capacity[cls]:
                 self._full_counter.inc()
+                _rejected_counters[cls].inc()
                 raise ServingQueueFull(
                     "priority class %r at capacity (%d); shed load or "
                     "retry" % (cls, self.class_capacity[cls]))
@@ -266,6 +328,7 @@ class RequestQueue:
                 now = time.perf_counter()
                 if est is not None and now + est > request.deadline:
                     self._shed_counter.inc()
+                    _rejected_counters[cls].inc()
                     raise ServingOverloaded(
                         "deadline %.0fms away but estimated %s-class "
                         "queue wait is %.0fms (%d rows ahead at %.0f "
@@ -275,6 +338,12 @@ class RequestQueue:
                            self._service_rate))
             self._seq += 1
             request.seq = self._seq
+            if request.trace is None:
+                # mint the trace root HERE, at admission: every later
+                # event (queue wait, batch, retries, execute, terminal
+                # outcome) hangs under this id — ids are cheap enough
+                # to stamp unconditionally, emission stays sink-gated
+                request.trace = _tracing.new_trace()
             request.enqueue_wall = time.time()
             request.enqueue_ts = time.perf_counter()
             lane.append(request)
@@ -346,6 +415,13 @@ class RequestQueue:
         """{priority class: queued requests} snapshot."""
         with self._lock:
             return {cls: len(self._lanes[cls]) for cls in PRIORITY_CLASSES}
+
+    def class_rows(self):
+        """{priority class: queued ROWS} snapshot — the backlog unit the
+        autoscale signal divides by the service rate (a class may queue
+        few requests that carry many rows each)."""
+        with self._lock:
+            return dict(self._lane_rows)
 
     def last_seq(self):
         """Seq of the newest ADMITTED request — the drain watermark."""

@@ -43,6 +43,7 @@ import time
 
 from .. import observability as _obs
 from .. import resilience as _resilience
+from .worker import emit_lifecycle
 
 __all__ = ["CircuitBreaker", "ResilientDispatcher", "WorkerSupervisor"]
 
@@ -215,7 +216,7 @@ class ResilientDispatcher:
         return ok, failed
 
     @staticmethod
-    def _note_retry(exc, attempt, delay):
+    def _note_retry(exc, attempt, delay, requests=()):
         _retries.inc()
         tel = _obs.get_telemetry()
         if tel.recording:
@@ -224,14 +225,43 @@ class ResilientDispatcher:
                 "source": "serving", "error": repr(exc)[:200],
                 "attempt": attempt, "delay_s": delay,
             })
+        if tel.span_active():
+            # a retry belongs to EVERY request in the failed attempt:
+            # one instant per trace, so "why was this request slow"
+            # shows the transient fault it rode through
+            now = time.time()
+            err = repr(exc)[:120]
+            for r in requests:
+                trace = getattr(r, "trace", None)
+                if trace is not None:
+                    tel.record_span(
+                        "serving.retry", now, 0.0,
+                        tags=trace.child().tags(attempt=attempt,
+                                                delay_s=delay, error=err))
+
+    @staticmethod
+    def _note_bisect(requests):
+        _bisections.inc()
+        tel = _obs.get_telemetry()
+        if tel.span_active():
+            now = time.time()
+            for r in requests:
+                trace = getattr(r, "trace", None)
+                if trace is not None:
+                    tel.record_span(
+                        "serving.bisect", now, 0.0,
+                        tags=trace.child().tags(batch=len(requests)))
 
     def _dispatch(self, requests, policy=None):
         """Run ``requests`` to terminal outcomes; returns
         ``(n_succeeded, n_failed)``."""
+        def note(exc, attempt, delay):
+            self._note_retry(exc, attempt, delay, requests)
+
         try:
             _resilience.call_with_retry(self._execute, requests,
                                         policy=policy or self._policy,
-                                        on_retry=self._note_retry)
+                                        on_retry=note)
             return len(requests), 0
         except Exception as err:  # noqa: BLE001 — non-retryable/exhausted
             if len(requests) == 1:
@@ -241,7 +271,7 @@ class ResilientDispatcher:
                 return 0, 1
         # a fatal (or persistently "transient") multi-request batch:
         # bisect so innocents don't share the poison's fate
-        _bisections.inc()
+        self._note_bisect(requests)
         mid = len(requests) // 2
         ok_lo, bad_lo = self._dispatch(requests[:mid], self._bisect_policy)
         ok_hi, bad_hi = self._dispatch(requests[mid:], self._bisect_policy)
@@ -332,19 +362,17 @@ class WorkerSupervisor:
                         # keep failing pending work every tick: requests
                         # admitted after the drain must not hang either
                         t.fail_pending()
-                        if first and self._on_give_up is not None:
-                            self._on_give_up(t.name)
+                        if first:
+                            emit_lifecycle("give_up", t.name,
+                                           restarts=t.restarts)
+                            if self._on_give_up is not None:
+                                self._on_give_up(t.name)
                         continue
                     if t.restart():
                         t.restarts += 1
                         _worker_restarts.inc()
-                        tel = _obs.get_telemetry()
-                        if tel.recording:
-                            tel.emit({
-                                "type": "worker_restart", "ts": time.time(),
-                                "source": "serving", "worker": t.name,
-                                "restarts": t.restarts,
-                            })
+                        emit_lifecycle("restart", t.name,
+                                       restarts=t.restarts)
                 except Exception:
                     # the watchdog must outlive anything a probe raises
                     pass

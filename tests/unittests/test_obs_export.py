@@ -1,0 +1,418 @@
+"""Observability export plane: histogram cells, request-scoped tracing,
+Prometheus/healthz export, SLO monitoring, and JSONL sink rotation.
+
+The end-to-end assertions (quantile accuracy on a real latency sample,
+/metrics over HTTP from a live engine, per-request trace trees under
+injected faults, SLO breach alerts under overload) live in
+tools/check_obs_export.py, wired into tier-1 via
+test_obs_export_gate.py; this file covers the unit surface.
+"""
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import slo as slo_mod
+from paddle_tpu.observability import tracing
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_observe_and_stats():
+    h = obs.Histogram("h")
+    for v in (0.001, 0.002, 0.004, 0.008):
+        h.observe(v)
+    count, total, mean, mn, mx = h.stats()
+    assert count == 4 and mn == 0.001 and mx == 0.008
+    assert total == pytest.approx(0.015)
+    assert mean == pytest.approx(total / 4)
+    snap = h.snapshot()
+    assert snap.count == 4 and sum(snap.counts) == 4
+    assert h.quantile(0.5) == pytest.approx(0.002, rel=0.3)
+
+
+def test_histogram_empty_and_bounds_validation():
+    h = obs.Histogram("h")
+    assert h.stats() is None
+    assert h.snapshot().quantile(0.99) is None
+    assert h.snapshot().mean is None
+    with pytest.raises(ValueError):
+        h.snapshot().quantile(1.5)
+    with pytest.raises(ValueError):
+        obs.default_bounds(lo=-1.0)
+    with pytest.raises(ValueError):
+        obs.default_bounds(growth=0.9)
+
+
+def test_histogram_negative_clamps_and_overflow_reports_max():
+    h = obs.Histogram("h")
+    h.observe(-0.5)          # clock-skew artifact: lands in first bucket
+    assert h.snapshot().counts[0] == 1
+    big = obs.Histogram("big")
+    big.observe(500.0)       # above the last bound: overflow bucket
+    snap = big.snapshot()
+    assert snap.counts[-1] == 1
+    assert snap.quantile(0.99) == 500.0   # overflow clamps to observed max
+
+
+def test_histogram_merge_requires_same_layout():
+    a = obs.Histogram("a").snapshot()
+    b = obs.Histogram("b", bounds=(0.1, 1.0, 10.0)).snapshot()
+    with pytest.raises(ValueError):
+        a + b
+
+
+def test_histogram_delta_rejects_non_baseline():
+    h = obs.Histogram("h")
+    h.observe(0.01)
+    early = h.snapshot()
+    h.observe(0.02)
+    late = h.snapshot()
+    delta = late - early
+    assert delta.count == 1
+    assert delta.min is None and delta.max is None  # window extremes unknown
+    with pytest.raises(ValueError):
+        early - late
+
+
+def test_histogram_cumulative_matches_prometheus_shape():
+    h = obs.Histogram("h")
+    for v in (0.001, 0.01, 0.1):
+        h.observe(v)
+    pairs = list(h.snapshot().cumulative())
+    les = [le for le, _ in pairs]
+    cums = [c for _, c in pairs]
+    assert les[-1] == float("inf") and cums[-1] == 3
+    assert cums == sorted(cums)                       # monotone
+    assert les[:-1] == sorted(les[:-1])
+
+
+def test_histogram_thread_safety():
+    h = obs.Histogram("h")
+
+    def work():
+        for _ in range(2000):
+            h.observe(0.005)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 16000
+    assert sum(h.snapshot().counts) == 16000
+
+
+def test_registry_histogram_cells_reset_in_place():
+    tel = obs.Telemetry(enabled=True)
+    h = tel.histogram("ns.h")
+    assert tel.histogram("ns.h") is h      # one cell per name
+    h.observe(0.5)
+    tel.reset("ns.")
+    assert h.count == 0 and tel.histogram("ns.h") is h
+    assert "ns.h" in tel.histograms()
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_child_links_and_tags():
+    root = tracing.new_trace()
+    assert root.parent_id is None
+    child = root.child()
+    grand = child.child()
+    assert child.trace_id == root.trace_id == grand.trace_id
+    assert child.parent_id == root.span_id
+    assert grand.parent_id == child.span_id
+    assert len({root.span_id, child.span_id, grand.span_id}) == 3
+    tags = child.tags(rows=4)
+    assert tags["trace_id"] == root.trace_id
+    assert tags["parent_id"] == root.span_id
+    assert tags["rows"] == 4
+    root_tags = root.tags()
+    assert "parent_id" not in root_tags
+
+
+def test_build_trace_tree_reassembles_and_keeps_orphans():
+    root = tracing.new_trace()
+    a, b = root.child(), root.child()
+    a2 = a.child()
+    orphan = tracing.TraceContext(root.trace_id,
+                                  parent_id="never-captured")
+    other = tracing.new_trace()
+    spans = [
+        {"name": "root", "tags": root.tags()},
+        {"name": "a", "tags": a.tags()},
+        {"name": "b", "tags": b.tags()},
+        {"name": "a2", "tags": a2.tags()},
+        {"name": "orphan", "tags": orphan.tags()},
+        {"name": "other", "tags": other.tags()},   # different trace
+    ]
+    roots, nodes = obs.build_trace_tree(spans, root.trace_id)
+    assert len(nodes) == 5                         # "other" filtered out
+    names = {n["span"]["name"] for n in nodes.values()}
+    assert "other" not in names
+    # the true root plus the orphan (parent never captured) surface
+    assert {r["span"]["name"] for r in roots} == {"root", "orphan"}
+    tree_root = next(r for r in roots if r["span"]["name"] == "root")
+    assert {c["span"]["name"] for c in tree_root["children"]} == {"a", "b"}
+    a_node = next(c for c in tree_root["children"]
+                  if c["span"]["name"] == "a")
+    assert [c["span"]["name"] for c in a_node["children"]] == ["a2"]
+
+
+def test_trace_ids_unique_across_threads():
+    seen = []
+    lock = threading.Lock()
+
+    def mint():
+        local = [tracing.new_trace().span_id for _ in range(500)]
+        with lock:
+            seen.extend(local)
+
+    threads = [threading.Thread(target=mint) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(seen)) == len(seen) == 4000
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_name_sanitization():
+    assert obs.prometheus_name("serving.queue_depth") == \
+        "paddle_tpu_serving_queue_depth"
+    assert obs.prometheus_name("a-b c", prefix="") == "a_b_c"
+    assert obs.prometheus_name("9lives", prefix="") == "_9lives"
+
+
+def test_render_prometheus_all_cell_kinds():
+    tel = obs.Telemetry(enabled=True)
+    tel.counter("c").inc(3)
+    tel.gauge("g").set(1.5)
+    tel.gauge("g_str").set("ready")       # non-numeric: skipped
+    tel.gauge("g_unset")                  # None: skipped
+    tel.timer("t").observe(0.5)
+    tel.histogram("h").observe(0.25)
+    text = obs.render_prometheus(tel)
+    assert text.endswith("\n")
+    assert "# TYPE paddle_tpu_c_total counter" in text
+    assert "paddle_tpu_c_total 3.0" in text
+    assert "paddle_tpu_g 1.5" in text
+    assert "g_str" not in text and "g_unset" not in text
+    assert "paddle_tpu_t_seconds_count 1" in text
+    assert "paddle_tpu_t_seconds_sum 0.5" in text
+    assert "# TYPE paddle_tpu_h_seconds histogram" in text
+    assert 'paddle_tpu_h_seconds_bucket{le="+Inf"} 1.0' in text
+    assert "paddle_tpu_h_seconds_count 1.0" in text
+
+
+def test_metrics_server_serves_scrape_and_404():
+    tel = obs.Telemetry(enabled=True)
+    tel.counter("hits").inc(7)
+    srv = obs.MetricsServer(telemetry=tel)
+    assert not srv.running
+    with srv:
+        assert srv.running and srv.port != 0
+        body = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=10).read().decode()
+        assert "paddle_tpu_hits_total 7.0" in body
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as resp:
+            health = json.loads(resp.read().decode())
+        assert health["ready"] is True                # default health fn
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+        assert e.value.code == 404
+        assert srv.scrapes == 1
+    assert not srv.running
+    srv.stop()   # idempotent
+
+
+def test_metrics_server_broken_health_answers_500():
+    def bad_health():
+        raise RuntimeError("probe exploded")
+
+    with obs.MetricsServer(health_fn=bad_health) as srv:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert e.value.code == 500
+
+
+# ---------------------------------------------------------------------------
+# slo
+# ---------------------------------------------------------------------------
+
+
+def test_slo_target_validation():
+    with pytest.raises(ValueError):
+        obs.SLOTarget("no_such_class")
+    with pytest.raises(ValueError):
+        obs.SLOMonitor([obs.SLOTarget("batch"), obs.SLOTarget("batch")])
+
+
+def _quiet_monitor(targets=(), **kw):
+    kw.setdefault("telemetry", obs.get_telemetry())
+    kw.setdefault("backlog_fn", dict)
+    kw.setdefault("service_rate_fn", lambda: None)
+    return obs.SLOMonitor(targets, **kw)
+
+
+def test_desired_replicas_formula():
+    mon = _quiet_monitor(min_replicas=1, max_replicas=8, drain_target_s=1.0)
+    # cold estimator: never scale on no data
+    assert mon.desired_replicas(0, {}, None) == 1
+    # 100 rows of interactive backlog at 25 rows/s/replica over 1s -> 4
+    assert mon.desired_replicas(100, {"interactive": 100}, 25.0) == 4
+    # strictly higher-priority backlog counts against lower classes
+    assert mon.desired_replicas(
+        100, {"interactive": 75, "best_effort": 25}, 25.0) == 4
+    # clamped at max_replicas
+    assert mon.desired_replicas(10000, {"batch": 10000}, 1.0) == 8
+    # a breached window floors above min even with no backlog
+    assert mon.desired_replicas(0, {}, 25.0, breached=True) == 2
+
+
+def test_slo_monitor_min_requests_guard_and_alert_flow():
+    tel = obs.get_telemetry()
+    done = tel.counter("serving.done_interactive")
+    met = tel.counter("serving.deadline_met_interactive")
+    hist = tel.histogram("serving.request_latency_interactive")
+    fired = []
+    mon = _quiet_monitor(
+        [obs.SLOTarget("interactive", goodput=0.99, p99_ms=1.0,
+                       min_requests=10)],
+        on_alert=fired.append)
+    # below min_requests: no breach decision from a meaningless window
+    done.inc(3)
+    report = mon.evaluate()
+    assert not report["alerts"]
+    # a real window: 20 attempts, none meeting the deadline, slow tail
+    done.inc(20)
+    for _ in range(20):
+        hist.observe(0.5)
+    report = mon.evaluate()
+    kinds = {a.kind for a in report["alerts"]}
+    assert kinds == {"goodput", "p99_ms"}
+    assert fired == report["alerts"]
+    assert list(mon.alerts)[-len(report["alerts"]):] == report["alerts"]
+    entry = report["per_class"]["interactive"]
+    assert entry["attempts"] == 20 and entry["goodput"] == 0.0
+    assert entry["p99_ms"] == pytest.approx(500.0, rel=0.3)
+    rec = report["alerts"][0].as_record()
+    assert rec["type"] == "slo_alert" and rec["priority"] == "interactive"
+    # next window is clean: baselines rolled
+    assert not mon.evaluate()["alerts"]
+    # and a healthy window (goodput met) stays quiet
+    done.inc(20)
+    met.inc(20)
+    for _ in range(20):
+        hist.observe(0.0001)
+    assert not mon.evaluate()["alerts"]
+
+
+def test_slo_monitor_alert_hook_failure_does_not_stop_monitoring():
+    tel = obs.get_telemetry()
+    done = tel.counter("serving.done_batch")
+
+    def boom(alert):
+        raise RuntimeError("hook exploded")
+
+    mon = _quiet_monitor([obs.SLOTarget("batch", goodput=0.99,
+                                        min_requests=1)],
+                         on_alert=boom)
+    done.inc(5)
+    report = mon.evaluate()     # must not raise
+    assert report["alerts"]
+    assert mon.evaluations == 1
+
+
+def test_slo_monitor_background_thread_start_stop():
+    mon = _quiet_monitor([], window_s=0.02)
+    mon.start()
+    assert mon.running
+    assert mon.start() is mon    # idempotent
+    deadline = 50
+    while mon.evaluations == 0 and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    mon.stop()
+    assert not mon.running
+    assert mon.evaluations >= 1
+
+
+# ---------------------------------------------------------------------------
+# jsonl sink: flush-at-exit registration + size rotation
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_sink_rotation_keeps_bounded_parseable_files(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    sink = obs.JsonlSink(path, max_bytes=400, max_files=3)
+    for i in range(100):
+        sink.emit({"type": "step", "step": i, "pad": "x" * 40})
+    sink.close()
+    assert sink.rotations > 0
+    files = sorted(os.listdir(tmp_path))
+    assert "t.jsonl" in files
+    rotated = [f for f in files if f.startswith("t.jsonl.")]
+    assert rotated and len(rotated) <= 3
+    # every file (current + rotated) is independently parseable and no
+    # line was torn by a rotation
+    total = 0
+    for f in files:
+        with open(str(tmp_path / f)) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                assert rec["type"] == "step"
+                total += 1
+    # oldest records beyond the window were dropped, newest survive
+    assert 0 < total <= 100
+    assert json.loads(open(path).readlines()[-1])["step"] == 99
+
+
+def test_jsonl_sink_span_mode_writes_span_lines(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    sink = obs.JsonlSink(path, spans=True)
+    assert sink.wants_spans
+    tel = obs.Telemetry(enabled=True)
+    tel.add_sink(sink)
+    ctx = tracing.new_trace()
+    tel.record_span("unit.span", 123.0, 0.5, tags=ctx.tags(rows=2))
+    sink.close()
+    rec = json.loads(open(path).read())
+    assert rec["type"] == "span" and rec["name"] == "unit.span"
+    assert rec["dur"] == 0.5
+    assert rec["tags"]["trace_id"] == ctx.trace_id
+    assert rec["tags"]["rows"] == 2
+    # trees reassemble from the JSONL shape directly
+    roots, _ = obs.build_trace_tree([rec], ctx.trace_id)
+    assert len(roots) == 1
+
+
+def test_jsonl_sink_atexit_flush_registered(tmp_path):
+    from paddle_tpu.observability import sinks as sinks_mod
+
+    path = str(tmp_path / "f.jsonl")
+    sink = obs.JsonlSink(path)
+    assert sink in sinks_mod._LIVE_JSONL
+    sink.emit({"type": "step", "step": 1})
+    # buffered: nothing durable yet (64KB buffer)
+    sinks_mod._flush_jsonl_sinks_at_exit()
+    assert json.loads(open(path).read())["step"] == 1
+    sink.close()
+    # closed sinks are skipped without raising
+    sinks_mod._flush_jsonl_sinks_at_exit()

@@ -179,6 +179,79 @@ def test_chrome_trace_sink_structure(tmp_path):
     assert span["ts"] == pytest.approx(100.0 * 1e6)
 
 
+def test_stdout_summary_sink_concurrent_rollover_loses_nothing():
+    """8 threads hammering every_n-windowed emit: rollovers race, but
+    every record lands in exactly one flushed window (the per-window
+    ``(n=K)`` counts must sum to the total emitted) and no line is
+    interleaved mid-write."""
+    import io
+
+    stream = io.StringIO()
+    sink = obs.StdoutSummarySink(every_n=5, stream=stream)
+    per_thread, n_threads = 250, 8
+
+    def work(tid):
+        for i in range(per_thread):
+            sink.emit({"type": "step", "source": "t%d" % tid, "step": i,
+                       "steps_per_s": 100.0, "feed_host_copies": 0,
+                       "prefetch_transfers": 0})
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.flush()   # drain the final partial window
+    lines = stream.getvalue().splitlines()
+    counted = 0
+    for line in lines:
+        assert line.startswith("[telemetry] ")     # no torn interleaving
+        assert "steps/s (n=" in line
+        counted += int(line.split("(n=")[1].split(")")[0])
+    assert counted == per_thread * n_threads
+    sink.flush()   # empty window: no extra output
+    assert stream.getvalue().splitlines() == lines
+
+
+def test_chrome_trace_sink_concurrent_thread_metadata(tmp_path):
+    """Spans emitted from 6 racing threads: the trace must contain
+    exactly one thread_name metadata event per emitting thread, unique
+    tids, and every span filed under ITS OWN thread's tid — per-thread
+    attribution must survive the tid-allocation race."""
+    path = str(tmp_path / "trace.json")
+    sink = obs.ChromeTraceSink(path)
+    per_thread, n_threads = 200, 6
+
+    def work(tid):
+        me = threading.current_thread()
+        for i in range(per_thread):
+            sink.emit_span("op-%d" % tid, 100.0 + i * 1e-4, 1e-5, me,
+                           {"thread_tag": tid})
+
+    threads = [threading.Thread(target=work, args=(t,),
+                                name="emitter-%d" % t)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    events = json.load(open(path))["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len(spans) == per_thread * n_threads      # nothing lost
+    names = sorted(m["args"]["name"] for m in metas)
+    assert names == sorted("emitter-%d" % t for t in range(n_threads))
+    tids = [m["tid"] for m in metas]
+    assert len(set(tids)) == n_threads               # unique tracks
+    tid_by_name = {m["args"]["name"]: m["tid"] for m in metas}
+    for span in spans:
+        emitter = int(span["args"]["thread_tag"])
+        assert span["tid"] == tid_by_name["emitter-%d" % emitter], (
+            "span attributed to the wrong thread track")
+
+
 def test_print_report_respects_killswitch(capsys):
     tel = obs.get_telemetry()
     old = tel.enabled
