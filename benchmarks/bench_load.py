@@ -44,10 +44,26 @@ terminal outcome (no hangs), overload actually shed something, the
 priority ladder holds (interactive goodput strictly above best_effort),
 and transient faults were retried without losing requests.
 
+Multi-replica serving (``serving.ReplicaPool``) rides the same harness:
+``--replicas N`` serves every leg from an N-replica pool over forced
+host devices instead of a single engine (same admission surface, so
+nothing else changes), and ``--scaling`` runs the replica-scaling
+ladder — ONE warm 4-replica pool whose ACTIVE rotation is resized
+1 → 2 → 4 between legs (``set_active_replicas``, i.e. the autoscale
+path under live traffic), all legs offered the SAME fixed rate derived
+from the measured 1-replica capacity.  Because the ``slow_execute``
+shim makes per-dispatch service time a sleep-dominated constant, the
+ladder is machine-independent: per-class goodput is reported per
+rotation size, and smoke mode asserts aggregate within-deadline answers
+at N=4 >= 2.5x N=1 (the tier-1 scaling floor, gated via
+tools/check_replica_pool.py).
+
 Usage:
   python benchmarks/bench_load.py             # full run, prints JSON
   python benchmarks/bench_load.py --smoke     # quick run + assertions
   python benchmarks/bench_load.py --process bursty --overload 5
+  python benchmarks/bench_load.py --replicas 4 --smoke
+  python benchmarks/bench_load.py --scaling --smoke
 """
 from __future__ import annotations
 
@@ -101,11 +117,23 @@ def save_model(dirname):
     return dirname
 
 
-def make_engine(model_dir):
+def make_engine(model_dir, replicas=1, max_replicas=None):
+    """One serving frontend: a single engine (``replicas=1``) or an
+    N-replica pool — same admission surface, so every leg below is
+    agnostic to which it got."""
     from paddle_tpu import serving
 
-    return serving.InferenceEngine(
-        model_dir, batch_buckets=(2, 4, 8, 16), max_batch_size=16,
+    if replicas == 1 and max_replicas is None:
+        return serving.InferenceEngine(
+            model_dir, batch_buckets=(2, 4, 8, 16), max_batch_size=16,
+            batch_timeout_ms=0.0, queue_capacity=QUEUE_CAPACITY,
+            class_capacity=CLASS_CAPACITY, backend="program",
+            breaker_threshold=8, breaker_cooldown_s=0.5,
+            supervisor_interval_s=0.05)
+    return serving.ReplicaPool(
+        model_dir, replicas=max_replicas or replicas,
+        initial_replicas=replicas,
+        batch_buckets=(2, 4, 8, 16), max_batch_size=16,
         batch_timeout_ms=0.0, queue_capacity=QUEUE_CAPACITY,
         class_capacity=CLASS_CAPACITY, backend="program",
         breaker_threshold=8, breaker_cooldown_s=0.5,
@@ -296,13 +324,13 @@ def run_leg(engine, process, rate, n, seed, capacity, flaky_every=0):
     return {"per_class": per_class, "overall": overall}
 
 
-def run_load_bench(smoke, process, overload, n_requests, seed):
+def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1):
     from paddle_tpu.testing import faults
 
     td = tempfile.mkdtemp()
     model_dir = save_model(os.path.join(td, "model"))
     legs = {}
-    engine = make_engine(model_dir)
+    engine = make_engine(model_dir, replicas=replicas)
     old_switch = sys.getswitchinterval()
     sys.setswitchinterval(0.001)
     try:
@@ -329,6 +357,7 @@ def run_load_bench(smoke, process, overload, n_requests, seed):
     out = {
         "model": "mlp 2x%d + %.0fms service shim" % (WIDTH,
                                                      SERVICE_DELAY_S * 1e3),
+        "replicas": replicas,
         "capacity_req_s": round(capacity, 1),
         "overload_factor": overload,
         "offered_rate_req_s": round(rate, 1),
@@ -339,6 +368,77 @@ def run_load_bench(smoke, process, overload, n_requests, seed):
     if smoke:
         _assert_smoke(out)
     return out
+
+
+SCALING_LADDER = (1, 2, 4)
+
+
+def run_scaling_bench(smoke, overload, n_requests, seed):
+    """Replica-scaling ladder: ONE warm pool of ``max(SCALING_LADDER)``
+    replicas; for each rung the ACTIVE rotation is resized
+    (``set_active_replicas`` — the autoscale path) and the same fixed
+    offered rate (``overload`` x the measured 1-replica capacity) is
+    replayed open-loop.  Per-class goodput per rung; smoke asserts the
+    tier-1 scaling floor — aggregate within-deadline answers at the top
+    rung >= 2.5x the bottom rung — which the ``slow_execute`` shim makes
+    machine-independent (service time is a sleep, not host CPU)."""
+    from paddle_tpu.testing import faults
+
+    td = tempfile.mkdtemp()
+    model_dir = save_model(os.path.join(td, "model"))
+    top = max(SCALING_LADDER)
+    pool = make_engine(model_dir, replicas=min(SCALING_LADDER),
+                       max_replicas=top)
+    rungs = {}
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    try:
+        with faults.slow_execute(SERVICE_DELAY_S):
+            capacity1 = measure_capacity(pool, seconds=0.5 if smoke else 1.5)
+            rate = overload * capacity1   # FIXED across rungs
+            for n in SCALING_LADDER:
+                applied = pool.set_active_replicas(n, reason="bench_ladder")
+                assert applied == n, (applied, n)
+                rungs["replicas_%d" % n] = run_leg(
+                    pool, "poisson", rate, n_requests, seed, capacity1)
+                rungs["replicas_%d" % n]["active_replicas"] = n
+    finally:
+        sys.setswitchinterval(old_switch)
+        pool.stop()
+    out = {
+        "model": "mlp 2x%d + %.0fms service shim" % (WIDTH,
+                                                     SERVICE_DELAY_S * 1e3),
+        "ladder": list(SCALING_LADDER),
+        "capacity_1_replica_req_s": round(capacity1, 1),
+        "overload_factor": overload,
+        "offered_rate_req_s": round(rate, 1),
+        "requests_per_rung": n_requests,
+        "seed": seed,
+        "rungs": rungs,
+    }
+    if smoke:
+        _assert_scaling_smoke(out)
+    return out
+
+
+def _good_total(leg):
+    return sum(c["ok_within_deadline"] for c in leg["per_class"].values())
+
+
+def _assert_scaling_smoke(report):
+    rungs = report["rungs"]
+    for name, leg in rungs.items():
+        assert leg["overall"]["unresolved"] == 0, (name, leg["overall"])
+    lo = rungs["replicas_%d" % min(SCALING_LADDER)]
+    hi = rungs["replicas_%d" % max(SCALING_LADDER)]
+    g_lo, g_hi = _good_total(lo), _good_total(hi)
+    assert g_lo > 0, "1-replica rung answered nothing within deadline"
+    # the tier-1 scaling floor (tools/check_replica_pool.py): under a
+    # fixed offered rate that overloads one replica, 4 replicas must
+    # deliver >= 2.5x the within-deadline answers
+    assert g_hi >= 2.5 * g_lo, (
+        "replica scaling floor missed: %d good at N=%d vs %d at N=%d "
+        "(< 2.5x)" % (g_hi, max(SCALING_LADDER), g_lo, min(SCALING_LADDER)))
 
 
 def _smoke_ladder_holds(legs):
@@ -379,26 +479,56 @@ def _assert_smoke(report):
         "faulty legs recorded no retries")
 
 
+def _ensure_host_devices(n):
+    """Force >= ``n`` virtual CPU devices for the replica legs.  Only
+    effective BEFORE jax's backend initializes — env-only here; when jax
+    is already imported (in-process callers) the caller's mesh rules."""
+    if "jax" in sys.modules:
+        return
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    os.environ["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=%d" % n]).strip()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true",
                         help="quick deterministic pass + SLO assertions")
     parser.add_argument("--process", choices=["poisson", "bursty"],
                         default=None, help="run only one arrival process")
-    parser.add_argument("--overload", type=float, default=3.0,
-                        help="offered rate as a multiple of capacity")
+    parser.add_argument("--overload", type=float, default=None,
+                        help="offered rate as a multiple of capacity "
+                             "(default 3; 4 for --scaling, so the top "
+                             "rung is at its aggregate capacity while "
+                             "the bottom rung is 4x overloaded)")
     parser.add_argument("--requests", type=int, default=None,
                         help="arrivals per leg")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serve the legs from a ReplicaPool of N "
+                             "device-pinned replicas (1 = single engine)")
+    parser.add_argument("--scaling", action="store_true",
+                        help="replica-scaling ladder: one warm pool, "
+                             "rotation resized %s, fixed offered rate"
+                             % (SCALING_LADDER,))
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
     if "JAX_PLATFORMS" not in os.environ and "JAX_PLATFORM_NAME" not in os.environ:
         os.environ["JAX_PLATFORMS"] = "cpu"
+    if args.scaling or args.replicas > 1:
+        _ensure_host_devices(max(max(SCALING_LADDER), args.replicas))
 
-    n = args.requests or (600 if args.smoke else 2400)
-    results = {"mode": "smoke" if args.smoke else "full",
-               "load": run_load_bench(args.smoke, args.process,
-                                      args.overload, n, args.seed)}
+    results = {"mode": "smoke" if args.smoke else "full"}
+    if args.scaling:
+        n = args.requests or (1600 if args.smoke else 3200)
+        results["scaling"] = run_scaling_bench(
+            args.smoke, args.overload or 4.0, n, args.seed)
+    else:
+        n = args.requests or (600 if args.smoke else 2400)
+        results["load"] = run_load_bench(args.smoke, args.process,
+                                         args.overload or 3.0, n, args.seed,
+                                         replicas=args.replicas)
     print(json.dumps(results, indent=2, sort_keys=True))
     return results
 

@@ -16,6 +16,19 @@ it into a server —
     engine.swap_model("model_dir_v2")         # hot swap: load, drain, flip
     engine.stop()
 
+To serve from every chip instead of one, swap the constructor for
+:class:`ReplicaPool` — same surface, N device-pinned replicas behind
+ONE shared admission queue, least-loaded pull dispatch, per-replica
+circuit breakers + supervised workers, ROLLING ``swap_model`` (drain +
+flip one replica at a time, capacity never zero), and autoscale
+activate/quiesce driven by the ``SLOMonitor``'s
+``serving.autoscale.desired_replicas`` signal (replica_pool.py;
+docs/serving.md "Replica pool")::
+
+    pool = serving.ReplicaPool("model_dir", replicas=4)   # jax.devices()
+    out = pool.predict({"x": x})              # bitwise == engine.predict
+    pool.start_autoscaler(obs.SLOMonitor([...], engine=pool))
+
 Autoregressive generation rides the same engine: construct it with
 ``decode_model=`` (see ``models.transformer.build_decode_model``) and
 call ``generate()``/``generate_async()`` — continuous batching
@@ -56,14 +69,14 @@ goodput-under-deadline per class against open-loop overload.
 """
 from __future__ import annotations
 
-from .batcher import DynamicBatcher
+from .batcher import CompletionTracker, DynamicBatcher
 from .decode_scheduler import (
     DecodeConfig,
     DecodeModel,
     DecodeScheduler,
     GenerateRequest,
 )
-from .engine import InferenceEngine
+from .engine import BatchExecutor, InferenceEngine
 from .errors import (
     ServingClosed,
     ServingDegraded,
@@ -74,12 +87,16 @@ from .errors import (
 )
 from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
 from .model_store import LoadedModel, ModelStore
+from .replica_pool import ReplicaPool
 from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
 from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
 
 __all__ = [
     "InferenceEngine",
+    "ReplicaPool",
+    "BatchExecutor",
     "DynamicBatcher",
+    "CompletionTracker",
     "ModelStore",
     "LoadedModel",
     "Request",

@@ -31,7 +31,19 @@ requests may complete out of admission order, so ``_mark_done`` tracks
 the completed-seq SET and advances ``completed_seq`` only over a
 contiguous prefix — :meth:`wait_for` ("everything admitted at or before
 seq N is finished") stays exact, which is what hot swap's drain step
-blocks on.
+blocks on.  The watermark lives in a :class:`CompletionTracker` so a
+replica pool can hand ONE tracker to every replica's batcher: requests
+complete on whichever replica served them, and the pool-level drain
+("everything admitted before the rolling swap began is answered")
+still blocks on one exact, global watermark.
+
+Two pool hooks, both inert for a standalone engine: ``tracker=`` (the
+shared watermark above) and ``gate=`` — a callable consulted before
+every queue pop.  A False gate parks the worker WITHOUT popping: the
+request stays in the shared queue for other replicas, which is how a
+pool ejects a replica from rotation (breaker open, draining for a
+rolling swap, quiesced by the autoscaler) while keeping its thread,
+model, and warmed buckets intact.
 
 Failure discipline: per-batch faults are ``Exception``s and the worker
 survives them (the engine's ResilientDispatcher retries/bisects before
@@ -50,11 +62,46 @@ from .. import observability as _obs
 from .errors import ServingClosed, ServingDegraded, ServingTimeout
 from .worker import RestartableWorker
 
-__all__ = ["DynamicBatcher"]
+__all__ = ["CompletionTracker", "DynamicBatcher"]
 
 _expired = _obs.counter("serving.expired")
 _queue_wait = _obs.timer("serving.queue_wait")
 _queue_wait_hist = _obs.histogram("serving.queue_wait")
+
+
+class CompletionTracker:
+    """Exact completion watermark over admission seqs.
+
+    ``mark_done`` records completed seqs (in any order — priority lanes
+    and multi-replica serving both complete out of admission order) and
+    advances ``completed_seq`` only over the contiguous prefix, so
+    :meth:`wait_for` ("everything admitted at or before seq N finished")
+    is exact.  One batcher owns one by default; a replica pool shares a
+    single tracker across every replica's batcher so its rolling-swap
+    drain has one global watermark.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self.completed_seq = 0
+        self._done_seqs = set()        # completed seqs above the watermark
+
+    def mark_done(self, requests):
+        with self._cond:
+            for r in requests:
+                if r.seq is not None and r.seq > self.completed_seq:
+                    self._done_seqs.add(r.seq)
+            while (self.completed_seq + 1) in self._done_seqs:
+                self.completed_seq += 1
+                self._done_seqs.discard(self.completed_seq)
+            self._cond.notify_all()
+
+    def wait_for(self, seq, timeout=None):
+        """Block until every request admitted at or before ``seq`` has
+        completed (answered, failed, or shed).  Returns False on timeout."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self.completed_seq >= seq, timeout)
 
 
 class DynamicBatcher:
@@ -65,19 +112,28 @@ class DynamicBatcher:
     ``max_batch_size``; any ``Exception`` it raises fails every request
     in the batch and the worker keeps serving — a poison request must
     not take the engine down.
+
+    ``tracker``: a shared :class:`CompletionTracker` (a replica pool's
+    global watermark); default = a private one.  ``gate``: pool hook —
+    a callable checked before every pop; False parks the worker without
+    claiming work (see module docstring).  A stop always exits a parked
+    worker, drain or not — a closed gate means the queued backlog
+    belongs to OTHER consumers, so this worker draining it would be
+    wrong; a caller that wants a gated worker to participate in its
+    drain must open the gate first (the pool's ``stop`` force-opens
+    every gate before it drains the shared watermark).
     """
 
     def __init__(self, queue, execute, max_batch_size, batch_timeout_s,
-                 name="paddle-tpu-serving-batcher"):
+                 name="paddle-tpu-serving-batcher", tracker=None, gate=None,
+                 label="batcher"):
         self._queue = queue
         self._execute = execute
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_s)
         self._drain = True
-        self._done_lock = threading.Lock()
-        self._done_cond = threading.Condition(self._done_lock)
-        self.completed_seq = 0
-        self._done_seqs = set()        # completed seqs above the watermark
+        self._tracker = tracker if tracker is not None else CompletionTracker()
+        self._gate = gate
         self.batches = 0
         self._inflight = None          # batch being dispatched right now
         # thread lifecycle (single-use Thread re-arming, life lock
@@ -85,7 +141,7 @@ class DynamicBatcher:
         # in the shared RestartableWorker — see worker.py
         self._worker = RestartableWorker(self._serve_loop, name,
                                          on_death=self._fail_inflight,
-                                         label="batcher")
+                                         label=label)
 
     def start(self):
         self._worker.start()
@@ -110,22 +166,18 @@ class DynamicBatcher:
         return self._worker.stopping
 
     # -- drain watermark -----------------------------------------------------
+    @property
+    def completed_seq(self):
+        return self._tracker.completed_seq
+
     def _mark_done(self, requests):
-        with self._done_cond:
-            for r in requests:
-                if r.seq is not None and r.seq > self.completed_seq:
-                    self._done_seqs.add(r.seq)
-            while (self.completed_seq + 1) in self._done_seqs:
-                self.completed_seq += 1
-                self._done_seqs.discard(self.completed_seq)
-            self._done_cond.notify_all()
+        self._tracker.mark_done(requests)
 
     def wait_for(self, seq, timeout=None):
         """Block until every request admitted at or before ``seq`` has
-        completed (answered, failed, or shed).  Returns False on timeout."""
-        with self._done_cond:
-            return self._done_cond.wait_for(
-                lambda: self.completed_seq >= seq, timeout)
+        completed (answered, failed, or shed) — on THIS batcher's tracker,
+        which a pool shares across replicas.  False on timeout."""
+        return self._tracker.wait_for(seq, timeout)
 
     # -- worker --------------------------------------------------------------
     def _pop_live(self, timeout, max_rows):
@@ -167,6 +219,16 @@ class DynamicBatcher:
                 # of serving the backlog — stop() fails the leftovers
                 # via drain_remaining once the thread is gone
                 return
+            if self._gate is not None and not self._gate():
+                # parked out of rotation: claim nothing (the shared
+                # queue's requests belong to the other replicas).  The
+                # gate callable itself records the park instant — the
+                # pool's drain handshake: a single-threaded worker seen
+                # at the gate has no dispatch in flight.
+                if self._worker.stopping:
+                    return
+                time.sleep(0.005)
+                continue
             head = self._pop_live(timeout=0.05, max_rows=None)
             if head is None:
                 if self._worker.stopping and (not self._drain

@@ -79,6 +79,27 @@ _queue_wait = _obs.timer("serving.decode.queue_wait")
 _queue_wait_hist = _obs.histogram("serving.decode.queue_wait")
 _ttft_hist = _obs.histogram("serving.decode.ttft")
 _step_hist = _obs.histogram("serving.decode.step")
+_prefill_retries = _obs.counter("serving.decode.prefill_retries")
+
+
+def _sample_token(logits, key, temp, top_k):
+    """One sampled token id: greedy argmax when ``temp <= 0``, else
+    temperature-scaled (optionally top-k-truncated) categorical draw
+    with ``key``.  Shape-stable and branch-free (``where``, not
+    ``cond``) so greedy and sampling requests share ONE compiled decode
+    step — a slot's sampling mode never changes the dispatched shape."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    z = logits / jnp.maximum(temp, 1e-6)
+    if top_k is not None:
+        # static k (a DecodeConfig knob): lax.top_k needs a compile-time
+        # k, so the menu of sampling truncations is fixed per scheduler
+        kth = jax.lax.top_k(z, top_k)[0][..., -1]
+        z = jnp.where(z < kth, -jnp.inf, z)
+    sampled = jax.random.categorical(key, z).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
 
 
 class DecodeModel:
@@ -130,12 +151,25 @@ class DecodeConfig:
     queue_capacity / default_deadline_ms: the PR-5 admission contract.
     kv_dtype: pool dtype (bf16 on chip halves KV HBM).
     warmup: compile the decode step + every prefill bucket up front.
+    default_temperature: sampling temperature for requests that don't
+        carry their own; ``0`` (the default) is greedy argmax.
+    top_k: restrict sampling to the k highest logits (None = the full
+        vocabulary).  STATIC — compiled into the decode step — because
+        ``lax.top_k`` needs a compile-time k; per-request knobs are
+        ``temperature``/``seed`` on :meth:`DecodeScheduler.submit`.
+    prefill_retries: transient prefill-dispatch faults are retried this
+        many times before the request fails typed.  The prefill leg is
+        REPLAYABLE — its KV-pool inputs are untouched by a failed
+        attempt (functional writes) — unlike the in-place decode step;
+        forced to 0 when pool donation is active (TPU), where a failed
+        dispatch consumes the pools.
     """
 
     def __init__(self, num_slots=4, page_size=16, max_seq_len=256,
                  num_pages=None, prefill_buckets=None, max_new_tokens=64,
                  max_active=None, queue_capacity=128,
-                 default_deadline_ms=None, kv_dtype="float32", warmup=True):
+                 default_deadline_ms=None, kv_dtype="float32", warmup=True,
+                 default_temperature=0.0, top_k=None, prefill_retries=2):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.max_seq_len = int(max_seq_len)
@@ -148,6 +182,13 @@ class DecodeConfig:
         self.default_deadline_ms = default_deadline_ms
         self.kv_dtype = kv_dtype
         self.warmup = bool(warmup)
+        self.default_temperature = float(default_temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.prefill_retries = int(prefill_retries)
+        if self.default_temperature < 0:
+            raise ValueError("default_temperature must be >= 0")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError("top_k must be >= 1 (or None for full vocab)")
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.max_active < 1 or self.max_active > self.num_slots:
@@ -160,20 +201,32 @@ class GenerateRequest(Request):
     """One admitted generation request; doubles as the caller's future.
 
     ``result(timeout)`` returns the generated token ids as an int32 array
-    (greedy decode; includes the EOS token when one stopped the
-    sequence).  ``token_times`` carries a ``time.perf_counter()`` stamp
-    per generated token — the inter-token-latency record the benchmark
-    reads.
+    (includes the EOS token when one stopped the sequence).
+    ``token_times`` carries a ``time.perf_counter()`` stamp per
+    generated token — the inter-token-latency record the benchmark
+    reads.  ``temperature``/``seed`` select the sampling mode:
+    temperature ``<= 0`` (or None with a greedy default config) is
+    argmax; positive temperature draws from the (optionally
+    top-k-truncated) softmax with a PRNG key derived from ``seed``,
+    folded with each token's absolute sequence position — the carried
+    key makes generation deterministic per ``(seed, prompt)`` and
+    independent of batch composition.  ``seed=None`` defaults to the
+    request's admission seq (stable within a scheduler run; pass an
+    explicit seed for cross-run determinism).
     """
 
-    __slots__ = ("prompt", "max_new_tokens", "token_times")
+    __slots__ = ("prompt", "max_new_tokens", "token_times", "temperature",
+                 "seed")
 
-    def __init__(self, prompt, max_new_tokens, deadline=None, priority=None):
+    def __init__(self, prompt, max_new_tokens, deadline=None, priority=None,
+                 temperature=None, seed=None):
         super().__init__(feed=None, rows=1, deadline=deadline,
                          priority=priority)
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.token_times = []
+        self.temperature = temperature
+        self.seed = seed
 
     @property
     def prompt_len(self):
@@ -239,6 +292,15 @@ class DecodeScheduler:
         # donation and would warn every dispatch
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
         self._donated = bool(donate)
+        # the prefill leg is replayable (its pool inputs survive a failed
+        # attempt — KV writes are functional), so transient dispatch
+        # faults retry instead of fail-typing the request.  NOT with
+        # donation: a failed donated dispatch already consumed the pools,
+        # so there is nothing valid to replay against.
+        self._prefill_policy = _resilience.RetryPolicy(
+            max_retries=0 if self._donated else cfg.prefill_retries,
+            base_delay=0.02, max_delay=0.25,
+            classify=_resilience.is_transient_error)
         self._jit = JitStepCache(
             lambda key: self._build_step(key, donate),
             cap=len(self.prefill_buckets) + 8, name="decode-steps")
@@ -271,23 +333,37 @@ class DecodeScheduler:
     # -- compiled steps ------------------------------------------------------
     def _build_step(self, key, donate):
         import jax
-        import jax.numpy as jnp
 
         model = self.model
+        # static truncation menu; never wider than the vocabulary
+        top_k = self.config.top_k
+        if top_k is not None:
+            top_k = min(top_k, model.vocab_size)
         if key[0] == "decode":
-            def decode(tokens, positions, k_pool, v_pool, tables, kv_lens):
+            def decode(tokens, positions, k_pool, v_pool, tables, kv_lens,
+                       seeds, temps):
                 logits, k_pool, v_pool = model.decode_fn(
                     tokens, positions, k_pool, v_pool, tables, kv_lens)
-                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                        k_pool, v_pool)
+
+                def samp(logit, seed, pos, temp):
+                    # the carried per-request key, folded with the
+                    # sampled token's ABSOLUTE position (kv_lens = the
+                    # new token's index) — identical between continuous
+                    # batching and solo serving, whatever the slot mix
+                    k = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+                    return _sample_token(logit, k, temp, top_k)
+
+                toks = jax.vmap(samp)(logits, seeds, kv_lens, temps)
+                return toks, k_pool, v_pool
 
             return jax.jit(decode, donate_argnums=donate)
 
-        def prefill(tokens, length, k_pool, v_pool, pages):
+        def prefill(tokens, length, k_pool, v_pool, pages, seed, temp):
             logits, k, v = model.prefill_fn(tokens, length)
             k_pool, v_pool = write_prompt_kv(k_pool, v_pool, k, v, pages)
-            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                    k_pool, v_pool)
+            # first sampled token sits at absolute position `length`
+            kk = jax.random.fold_in(jax.random.PRNGKey(seed), length)
+            return _sample_token(logits, kk, temp, top_k), k_pool, v_pool
 
         return jax.jit(prefill, donate_argnums=donate)
 
@@ -304,7 +380,9 @@ class DecodeScheduler:
                 jnp.zeros((cfg.num_slots,), jnp.int32),
                 self._cache.k_pool, self._cache.v_pool,
                 jnp.asarray(self._tables),
-                jnp.zeros((cfg.num_slots,), jnp.int32))
+                jnp.zeros((cfg.num_slots,), jnp.int32),
+                jnp.zeros((cfg.num_slots,), jnp.uint32),
+                jnp.zeros((cfg.num_slots,), jnp.float32))
             np.asarray(toks)
             self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
             for b in self.prefill_buckets:
@@ -312,7 +390,8 @@ class DecodeScheduler:
                 toks, k_pool, v_pool = fn(
                     jnp.zeros((b,), jnp.int32), jnp.int32(1),
                     self._cache.k_pool, self._cache.v_pool,
-                    jnp.zeros((b // cfg.page_size,), jnp.int32))
+                    jnp.zeros((b // cfg.page_size,), jnp.int32),
+                    jnp.uint32(0), jnp.float32(0))
                 np.asarray(toks)
                 self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
         return self
@@ -396,12 +475,15 @@ class DecodeScheduler:
 
     # -- client API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
-               priority=None):
+               priority=None, temperature=None, seed=None):
         """Admit one prompt; returns its :class:`GenerateRequest` future.
         Raises ``ServingClosed`` when stopped, ``ServingQueueFull`` under
         backpressure, ``ServingError`` for malformed prompts.
         ``priority`` is a :data:`~.request_queue.PRIORITY_CLASSES` lane
-        (admission order; decode slots themselves are shared)."""
+        (admission order; decode slots themselves are shared).
+        ``temperature`` (default: the config's, normally 0 = greedy) and
+        ``seed`` select per-request sampling — see
+        :class:`GenerateRequest`."""
         cfg = self.config
         tokens = np.asarray(prompt)
         if tokens.ndim != 1 or tokens.shape[0] < 1:
@@ -422,19 +504,24 @@ class DecodeScheduler:
             raise ServingError(
                 "prompt %d + max_new_tokens %d exceeds max_seq_len %d"
                 % (plen, n_new, cfg.max_seq_len))
+        if temperature is not None and float(temperature) < 0:
+            raise ServingError("temperature must be >= 0, got %r"
+                               % (temperature,))
         ms = deadline_ms if deadline_ms is not None else cfg.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
         req = self._queue.put(
             GenerateRequest(tokens, n_new, deadline=deadline,
-                            priority=priority))
+                            priority=priority, temperature=temperature,
+                            seed=seed))
         _requests.inc()
         return req
 
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
-                 timeout=None):
+                 timeout=None, temperature=None, seed=None):
         """Synchronous generate: the generated int32 token ids."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
-                           deadline_ms=deadline_ms).result(timeout=timeout)
+                           deadline_ms=deadline_ms, temperature=temperature,
+                           seed=seed).result(timeout=timeout)
 
     def stats(self):
         active = sum(1 for s in self._slots if s is not None)
@@ -452,6 +539,15 @@ class DecodeScheduler:
         }
 
     # -- worker --------------------------------------------------------------
+    def _sampling_params(self, req):
+        """(temperature float32, seed uint32) for one request: request
+        overrides, else the config default; a seedless sampling request
+        gets its admission seq (stable within this scheduler run)."""
+        temp = (req.temperature if req.temperature is not None
+                else self.config.default_temperature)
+        seed = req.seed if req.seed is not None else (req.seq or 0)
+        return np.float32(temp), np.uint32(int(seed) & 0xFFFFFFFF)
+
     def _active_count(self):
         return sum(1 for s in self._slots if s is not None)
 
@@ -597,19 +693,44 @@ class DecodeScheduler:
                 "serving.queue_wait", req.enqueue_wall, wait,
                 tags=req.trace.child().tags(priority=req.priority,
                                             seq=req.seq))
-        try:
+        temp, seed = self._sampling_params(req)
+
+        def attempt():
+            # the chaos choke point is consulted per ATTEMPT (a retry is
+            # a fresh dispatch, exactly like the predict path's)
             serve_fault = _resilience._serve_fault
             if serve_fault is not None:
                 serve_fault([req])
-            prefill_wall = time.time()
             with self._telemetry.timed("serving.decode.prefill",
                                        bucket=bucket, rows=req.prompt_len,
                                        seq=req.seq):
-                tok, k_pool, v_pool = fn(
+                tok, kp, vp = fn(
                     jnp.asarray(tokens), jnp.int32(req.prompt_len),
                     self._cache.k_pool, self._cache.v_pool,
-                    jnp.asarray(page_vec))
-                first = int(np.asarray(tok))
+                    jnp.asarray(page_vec), seed, temp)
+                return int(np.asarray(tok)), kp, vp
+
+        def note_retry(exc, attempt_n, delay):
+            _prefill_retries.inc()
+            tel = self._telemetry
+            if tel.recording:
+                tel.emit({
+                    "type": "serving_retry", "ts": time.time(),
+                    "source": "serving", "leg": "decode_prefill",
+                    "error": repr(exc)[:200], "attempt": attempt_n,
+                    "delay_s": delay, "seq": req.seq,
+                })
+            if tel.span_active() and req.trace is not None:
+                tel.record_span(
+                    "serving.retry", time.time(), 0.0,
+                    tags=req.trace.child().tags(leg="decode_prefill",
+                                                attempt=attempt_n,
+                                                error=repr(exc)[:120]))
+
+        try:
+            prefill_wall = time.time()
+            first, k_pool, v_pool = _resilience.call_with_retry(
+                attempt, policy=self._prefill_policy, on_retry=note_retry)
         except Exception as exc:  # noqa: BLE001 — worker must survive
             self._cache.free(pages)
             self._completed += 1
@@ -683,10 +804,13 @@ class DecodeScheduler:
         tokens = np.zeros((cfg.num_slots,), np.int32)
         positions = np.zeros((cfg.num_slots,), np.int32)
         kv_lens = np.zeros((cfg.num_slots,), np.int32)
+        seeds = np.zeros((cfg.num_slots,), np.uint32)
+        temps = np.zeros((cfg.num_slots,), np.float32)
         for i, slot in active:
             tokens[i] = slot.generated[-1]   # feed the last sampled token
             positions[i] = slot.kv_len       # ... at the next cache index
             kv_lens[i] = slot.kv_len + 1     # visible kv incl. this token
+            temps[i], seeds[i] = self._sampling_params(slot.req)
         fn = self._jit.get(("decode",))
         t0 = time.perf_counter()
         try:
@@ -698,7 +822,8 @@ class DecodeScheduler:
                 out, k_pool, v_pool = fn(
                     jnp.asarray(tokens), jnp.asarray(positions),
                     self._cache.k_pool, self._cache.v_pool,
-                    jnp.asarray(self._tables), jnp.asarray(kv_lens))
+                    jnp.asarray(self._tables), jnp.asarray(kv_lens),
+                    jnp.asarray(seeds), jnp.asarray(temps))
                 sampled = np.asarray(out)
         except Exception as exc:  # noqa: BLE001 — worker must survive
             for i, _ in active:

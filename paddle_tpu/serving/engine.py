@@ -57,7 +57,7 @@ from .model_store import ModelStore
 from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
 from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
 
-__all__ = ["InferenceEngine"]
+__all__ = ["BatchExecutor", "InferenceEngine", "normalize_feed"]
 
 _requests = _obs.counter("serving.requests")
 _batches = _obs.counter("serving.batches")
@@ -65,6 +65,230 @@ _batched_rows = _obs.counter("serving.batched_rows")
 _padded_rows = _obs.counter("serving.padded_rows")
 _swaps = _obs.counter("serving.swaps")
 _execute_hist = _obs.histogram("serving.execute")
+
+
+def normalize_feed(model, feed, max_batch_size):
+    """Validate + canonicalize one request's feed against ``model``'s
+    specs; returns ``({name: np.ndarray}, rows)``.  Shared by the engine
+    and the replica pool (one admission grammar, wherever the request
+    lands)."""
+    missing = [n for n in model.feed_names if n not in feed]
+    unknown = [n for n in feed if n not in model.feed_names]
+    if missing or unknown:
+        raise ServingError(
+            "feed names mismatch: missing %s, unknown %s (model feeds "
+            "%s)" % (missing, unknown, model.feed_names))
+    out = {}
+    rows = None
+    for name in model.feed_names:
+        shape, dtype = model.feed_specs[name]
+        arr = np.asarray(feed[name])
+        if arr.dtype != dtype:
+            arr = arr.astype(dtype, copy=False)
+        rest = len(shape) - 1
+        if arr.ndim == rest:         # single sample: add the batch dim
+            arr = arr[None]
+        elif arr.ndim != rest + 1:
+            raise ServingError(
+                "feed %r has %d dims; expected %d (%s with a leading "
+                "batch dim) or %d (one sample)"
+                % (name, arr.ndim, rest + 1, shape, rest))
+        for want, got in zip(shape[1:], arr.shape[1:]):
+            if want is not None and int(want) != int(got):
+                raise ServingError(
+                    "feed %r has shape %s but the model expects %s "
+                    "(None = batch)" % (name, arr.shape, shape))
+        n = arr.shape[0]
+        if rows is None:
+            rows = n
+        elif n != rows:
+            raise ServingError(
+                "inconsistent request rows: feed %r has %d, others %d"
+                % (name, n, rows))
+        out[name] = arr
+    if rows is None or rows < 1:
+        raise ServingError("empty request (zero rows)")
+    if rows > max_batch_size:
+        raise ServingError(
+            "request carries %d rows > max_batch_size %d; split it "
+            "client-side" % (rows, max_batch_size))
+    return out, rows
+
+
+class BatchExecutor:
+    """The padded-bucket batch dispatch, factored out of the engine so a
+    replica pool can run one per replica (each against its own
+    device-pinned model) without duplicating the concat → bucket-pad →
+    chunk → slice → complete pipeline or its telemetry.
+
+    ``get_model`` returns the CURRENT model for this dispatch (the
+    engine reads it under its model lock; a replica reads its own slot)
+    — resolved once per call, so a hot swap mid-queue never mixes
+    versions inside one batch.  ``queue_depth`` feeds the serve_batch
+    record; ``tags`` (e.g. ``{"replica": 2}``) ride every execute span
+    and record, which is how a pooled request's trace names the replica
+    that served it.  The callable either completes every request in the
+    list or raises having completed none — the all-at-the-end contract
+    retry/bisection (``ResilientDispatcher``) depends on.
+    """
+
+    def __init__(self, get_model, batch_buckets, queue_depth=None,
+                 tags=None):
+        buckets = sorted(set(int(b) for b in batch_buckets))
+        self._get_model = get_model
+        self.batch_buckets = tuple(buckets)
+        self._queue_depth = queue_depth or (lambda: 0)
+        self._tags = dict(tags or {})
+        self._telemetry = _obs.get_telemetry()
+        # bucket-histogram counter cells resolved once: the dispatch path
+        # must not pay a locked registry lookup + string format per batch
+        self._bucket_counters = {
+            b: _obs.counter("serving.batch_bucket_%d" % b)
+            for b in self.batch_buckets}
+
+    def _bucket_for(self, rows):
+        for b in self.batch_buckets:
+            if b >= rows:
+                return b
+        return self.batch_buckets[-1]
+
+    def _dispatch_chunk(self, model, feed_full, lo, hi, chunk_requests):
+        """Run rows [lo, hi) of the concatenated batch as one padded
+        bucket dispatch; returns ``(outs, batched_flags)``.
+        ``chunk_requests`` are the requests with rows in [lo, hi) — the
+        traces this dispatch is attributed to."""
+        n = hi - lo
+        n_requests = len(chunk_requests)
+        bucket = self._bucket_for(n)
+        pad = bucket - n
+        feed = {}
+        for name, arr in feed_full.items():
+            chunk = arr[lo:hi]
+            if pad:
+                # edge-replicate the last row: always a valid sample, and
+                # padding never changes other rows' results (rows are
+                # computed independently)
+                chunk = np.concatenate(
+                    [chunk, np.broadcast_to(chunk[-1:],
+                                            (pad,) + chunk.shape[1:])],
+                    axis=0)
+            feed[name] = chunk
+        tel = self._telemetry
+        wall0, t0 = time.time(), time.perf_counter()
+        with tel.timed("serving.execute", bucket=bucket, rows=n,
+                       requests=n_requests, version=model.version,
+                       **self._tags):
+            outs = model.predict_batch(feed)
+        exec_s = time.perf_counter() - t0
+        _execute_hist.observe(exec_s)
+        if tel.span_active():
+            # attribute THIS dispatch to every trace riding in it: the
+            # "execute" leaf of each request's tree (a retried dispatch
+            # emits one leaf per attempt that reached the model)
+            for r in chunk_requests:
+                if r.trace is not None:
+                    tel.record_span(
+                        "serving.execute", wall0, exec_s,
+                        tags=r.trace.child().tags(bucket=bucket, rows=n,
+                                                  version=model.version,
+                                                  **self._tags))
+        _batches.inc()
+        _batched_rows.inc(n)
+        _padded_rows.inc(pad)
+        self._bucket_counters[bucket].inc()
+        # which outputs carry the batch dim: warmup's observed ground
+        # truth when available (a non-batched fetch whose leading dim
+        # coincidentally equals one bucket must NOT be sliced), else the
+        # shape heuristic
+        known = model.batched_fetch
+        outs = [np.asarray(o) for o in outs]
+        flags = [(a.ndim >= 1 and a.shape[0] == bucket
+                  if known is None or j >= len(known) else known[j])
+                 for j, a in enumerate(outs)]
+        if tel.recording:
+            rec = {
+                "type": "serve_batch", "ts": time.time(),
+                "source": "serving", "bucket": bucket, "rows": n,
+                "requests": n_requests, "padded": pad,
+                "model_version": model.version,
+                "queue_depth": self._queue_depth(),
+            }
+            rec.update(self._tags)
+            tel.emit(rec)
+        return outs, flags
+
+    def __call__(self, requests):
+        # the serving-dispatch fault choke point: the chaos harness
+        # (testing.faults.flaky_execute / slow_execute / poison_request /
+        # kill_worker) hooks here, per dispatch ATTEMPT, with the exact
+        # request list — so retries and bisected sub-batches each consult
+        # it, exactly like a real per-dispatch runtime fault would hit
+        serve_fault = _resilience._serve_fault
+        if serve_fault is not None:
+            serve_fault(requests)
+        model = self._get_model()
+        rows = sum(r.rows for r in requests)
+        feed_full = {}
+        for name in model.feed_names:
+            parts = [r.feed[name] for r in requests]
+            feed_full[name] = (parts[0] if len(parts) == 1
+                               else np.concatenate(parts, axis=0))
+        cap = self.batch_buckets[-1]
+        if rows <= cap:
+            outs, flags = self._dispatch_chunk(model, feed_full, 0, rows,
+                                               requests)
+        else:
+            # an oversized coalesced batch (max_batch_size above the
+            # largest bucket, or oversized direct queue use) is CHUNKED
+            # across several bucket dispatches in row order — bucket
+            # padding never goes negative, per-request slices are
+            # reassembled below exactly as in the single-dispatch case
+            bounds = [(lo, min(lo + cap, rows))
+                      for lo in range(0, rows, cap)]
+            spans_by_req = self._request_spans(requests)
+            per_chunk = []
+            flags = None
+            for lo, hi in bounds:
+                chunk_reqs = [r for r, (r_lo, r_hi)
+                              in zip(requests, spans_by_req)
+                              if r_lo < hi and r_hi > lo]
+                outs_c, flags_c = self._dispatch_chunk(model, feed_full,
+                                                       lo, hi, chunk_reqs)
+                per_chunk.append((outs_c, flags_c, hi - lo))
+                flags = flags_c if flags is None else flags
+            outs = []
+            for j in range(len(per_chunk[0][0])):
+                if flags[j]:
+                    outs.append(np.concatenate(
+                        [c_outs[j][:n] for c_outs, _, n in per_chunk],
+                        axis=0))
+                else:
+                    # batch-dim-less fetch (scalar metric): each chunk
+                    # computes its own; share the first chunk's verbatim
+                    outs.append(per_chunk[0][0][j])
+        offset = 0
+        for r in requests:
+            result = []
+            for j, a in enumerate(outs):
+                if flags[j]:
+                    # copy: a view would pin the whole batch (and every
+                    # other request's rows) in memory via its base
+                    result.append(np.ascontiguousarray(
+                        a[offset:offset + r.rows]))
+                else:
+                    result.append(a)
+            offset += r.rows
+            # complete() emits the request's ROOT trace span and the
+            # per-class latency/goodput accounting (request_queue)
+            r.complete(result)
+
+    @staticmethod
+    def _request_spans(requests):
+        spans, lo = [], 0
+        for r in requests:
+            spans.append((lo, lo + r.rows))
+            lo += r.rows
+        return spans
 
 
 class InferenceEngine:
@@ -153,6 +377,9 @@ class InferenceEngine:
             self._model.warmup(self.batch_buckets)
         self._queue = RequestQueue(queue_capacity,
                                    class_capacity=class_capacity)
+        self._batch_core = BatchExecutor(
+            self._current_model, self.batch_buckets,
+            queue_depth=self._queue.depth)
         self._breaker = CircuitBreaker(threshold=breaker_threshold,
                                        cooldown_s=breaker_cooldown_s)
         self._dispatcher = ResilientDispatcher(
@@ -211,11 +438,6 @@ class InferenceEngine:
                             "is exhausted")))
             self._supervisor = sup
         self._telemetry = _obs.get_telemetry()
-        # bucket-histogram counter cells resolved once: the dispatch path
-        # must not pay a locked registry lookup + string format per batch
-        self._bucket_counters = {
-            b: _obs.counter("serving.batch_bucket_%d" % b)
-            for b in self.batch_buckets}
         self._metrics_server = None   # started only by serve_metrics()
         self._state = "ready"
         if autostart:
@@ -393,48 +615,7 @@ class InferenceEngine:
 
     # -- request admission ---------------------------------------------------
     def _normalize_feed(self, feed):
-        model = self._model
-        missing = [n for n in model.feed_names if n not in feed]
-        unknown = [n for n in feed if n not in model.feed_names]
-        if missing or unknown:
-            raise ServingError(
-                "feed names mismatch: missing %s, unknown %s (model feeds "
-                "%s)" % (missing, unknown, model.feed_names))
-        out = {}
-        rows = None
-        for name in model.feed_names:
-            shape, dtype = model.feed_specs[name]
-            arr = np.asarray(feed[name])
-            if arr.dtype != dtype:
-                arr = arr.astype(dtype, copy=False)
-            rest = len(shape) - 1
-            if arr.ndim == rest:         # single sample: add the batch dim
-                arr = arr[None]
-            elif arr.ndim != rest + 1:
-                raise ServingError(
-                    "feed %r has %d dims; expected %d (%s with a leading "
-                    "batch dim) or %d (one sample)"
-                    % (name, arr.ndim, rest + 1, shape, rest))
-            for want, got in zip(shape[1:], arr.shape[1:]):
-                if want is not None and int(want) != int(got):
-                    raise ServingError(
-                        "feed %r has shape %s but the model expects %s "
-                        "(None = batch)" % (name, arr.shape, shape))
-            n = arr.shape[0]
-            if rows is None:
-                rows = n
-            elif n != rows:
-                raise ServingError(
-                    "inconsistent request rows: feed %r has %d, others %d"
-                    % (name, n, rows))
-            out[name] = arr
-        if rows is None or rows < 1:
-            raise ServingError("empty request (zero rows)")
-        if rows > self.max_batch_size:
-            raise ServingError(
-                "request carries %d rows > max_batch_size %d; split it "
-                "client-side" % (rows, self.max_batch_size))
-        return out, rows
+        return normalize_feed(self._model, feed, self.max_batch_size)
 
     def predict_async(self, feed, deadline_ms=None, priority=None):
         """Admit one request; returns its :class:`Request` future
@@ -487,14 +668,16 @@ class InferenceEngine:
 
     # -- request admission: autoregressive decode ----------------------------
     def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None,
-                       priority=None):
+                       priority=None, temperature=None, seed=None):
         """Admit one generation prompt (1-D token ids); returns its
         :class:`~.decode_scheduler.GenerateRequest` future whose
         ``result(timeout)`` is the generated int32 token ids.  Requires
         the engine to have been constructed with ``decode_model=``.
         Same error contract as :meth:`predict_async` (``ServingClosed``
         / ``ServingQueueFull`` / ``ServingError``), and the same
-        ``priority`` classes."""
+        ``priority`` classes.  ``temperature``/``seed`` select
+        per-request sampling (greedy by default; see
+        :class:`~.decode_scheduler.GenerateRequest`)."""
         if self._state == "stopped":
             raise ServingClosed("engine is stopped")
         if self._decoder is None:
@@ -507,159 +690,34 @@ class InferenceEngine:
                 "engine degraded")
         return self._decoder.submit(prompt, max_new_tokens=max_new_tokens,
                                     deadline_ms=deadline_ms,
-                                    priority=priority)
+                                    priority=priority,
+                                    temperature=temperature, seed=seed)
 
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
-                 priority=None, timeout=None):
-        """Synchronous generate: greedy-decoded int32 token ids (stops at
-        the decode model's ``eos_id`` or ``max_new_tokens``)."""
+                 priority=None, timeout=None, temperature=None, seed=None):
+        """Synchronous generate: int32 token ids (greedy by default;
+        ``temperature``/``seed`` for sampling; stops at the decode
+        model's ``eos_id`` or ``max_new_tokens``)."""
         return self.generate_async(
             prompt, max_new_tokens=max_new_tokens,
-            deadline_ms=deadline_ms, priority=priority).result(
+            deadline_ms=deadline_ms, priority=priority,
+            temperature=temperature, seed=seed).result(
             timeout=timeout)
 
     # -- batch execution (batcher thread) ------------------------------------
-    def _bucket_for(self, rows):
-        for b in self.batch_buckets:
-            if b >= rows:
-                return b
-        return self.batch_buckets[-1]
+    def _current_model(self):
+        with self._model_lock:
+            return self._model
 
-    def _dispatch_chunk(self, model, feed_full, lo, hi, chunk_requests):
-        """Run rows [lo, hi) of the concatenated batch as one padded
-        bucket dispatch; returns ``(outs, batched_flags)``.
-        ``chunk_requests`` are the requests with rows in [lo, hi) — the
-        traces this dispatch is attributed to."""
-        n = hi - lo
-        n_requests = len(chunk_requests)
-        bucket = self._bucket_for(n)
-        pad = bucket - n
-        feed = {}
-        for name, arr in feed_full.items():
-            chunk = arr[lo:hi]
-            if pad:
-                # edge-replicate the last row: always a valid sample, and
-                # padding never changes other rows' results (rows are
-                # computed independently)
-                chunk = np.concatenate(
-                    [chunk, np.broadcast_to(chunk[-1:],
-                                            (pad,) + chunk.shape[1:])],
-                    axis=0)
-            feed[name] = chunk
-        tel = self._telemetry
-        wall0, t0 = time.time(), time.perf_counter()
-        with tel.timed("serving.execute", bucket=bucket, rows=n,
-                       requests=n_requests, version=model.version):
-            outs = model.predict_batch(feed)
-        exec_s = time.perf_counter() - t0
-        _execute_hist.observe(exec_s)
-        if tel.span_active():
-            # attribute THIS dispatch to every trace riding in it: the
-            # "execute" leaf of each request's tree (a retried dispatch
-            # emits one leaf per attempt that reached the model)
-            for r in chunk_requests:
-                if r.trace is not None:
-                    tel.record_span(
-                        "serving.execute", wall0, exec_s,
-                        tags=r.trace.child().tags(bucket=bucket, rows=n,
-                                                  version=model.version))
-        _batches.inc()
-        _batched_rows.inc(n)
-        _padded_rows.inc(pad)
-        self._bucket_counters[bucket].inc()
-        # which outputs carry the batch dim: warmup's observed ground
-        # truth when available (a non-batched fetch whose leading dim
-        # coincidentally equals one bucket must NOT be sliced), else the
-        # shape heuristic
-        known = model.batched_fetch
-        outs = [np.asarray(o) for o in outs]
-        flags = [(a.ndim >= 1 and a.shape[0] == bucket
-                  if known is None or j >= len(known) else known[j])
-                 for j, a in enumerate(outs)]
-        if tel.recording:
-            tel.emit({
-                "type": "serve_batch", "ts": time.time(),
-                "source": "serving", "bucket": bucket, "rows": n,
-                "requests": n_requests, "padded": pad,
-                "model_version": model.version,
-                "queue_depth": self._queue.depth(),
-            })
-        return outs, flags
+    def _bucket_for(self, rows):
+        return self._batch_core._bucket_for(rows)
 
     def _execute_batch(self, requests):
-        # the serving-dispatch fault choke point: the chaos harness
-        # (testing.faults.flaky_execute / slow_execute / poison_request /
-        # kill_worker) hooks here, per dispatch ATTEMPT, with the exact
-        # request list — so retries and bisected sub-batches each consult
-        # it, exactly like a real per-dispatch runtime fault would hit
-        serve_fault = _resilience._serve_fault
-        if serve_fault is not None:
-            serve_fault(requests)
-        with self._model_lock:
-            model = self._model
-        rows = sum(r.rows for r in requests)
-        feed_full = {}
-        for name in model.feed_names:
-            parts = [r.feed[name] for r in requests]
-            feed_full[name] = (parts[0] if len(parts) == 1
-                               else np.concatenate(parts, axis=0))
-        tel = self._telemetry
-        cap = self.batch_buckets[-1]
-        if rows <= cap:
-            outs, flags = self._dispatch_chunk(model, feed_full, 0, rows,
-                                               requests)
-        else:
-            # an oversized coalesced batch (max_batch_size above the
-            # largest bucket, or oversized direct queue use) is CHUNKED
-            # across several bucket dispatches in row order — bucket
-            # padding never goes negative, per-request slices are
-            # reassembled below exactly as in the single-dispatch case
-            bounds = [(lo, min(lo + cap, rows))
-                      for lo in range(0, rows, cap)]
-            spans_by_req = self._request_spans(requests)
-            per_chunk = []
-            flags = None
-            for lo, hi in bounds:
-                chunk_reqs = [r for r, (r_lo, r_hi)
-                              in zip(requests, spans_by_req)
-                              if r_lo < hi and r_hi > lo]
-                outs_c, flags_c = self._dispatch_chunk(model, feed_full,
-                                                       lo, hi, chunk_reqs)
-                per_chunk.append((outs_c, flags_c, hi - lo))
-                flags = flags_c if flags is None else flags
-            outs = []
-            for j in range(len(per_chunk[0][0])):
-                if flags[j]:
-                    outs.append(np.concatenate(
-                        [c_outs[j][:n] for c_outs, _, n in per_chunk],
-                        axis=0))
-                else:
-                    # batch-dim-less fetch (scalar metric): each chunk
-                    # computes its own; share the first chunk's verbatim
-                    outs.append(per_chunk[0][0][j])
-        offset = 0
-        for r in requests:
-            result = []
-            for j, a in enumerate(outs):
-                if flags[j]:
-                    # copy: a view would pin the whole batch (and every
-                    # other request's rows) in memory via its base
-                    result.append(np.ascontiguousarray(
-                        a[offset:offset + r.rows]))
-                else:
-                    result.append(a)
-            offset += r.rows
-            # complete() emits the request's ROOT trace span and the
-            # per-class latency/goodput accounting (request_queue)
-            r.complete(result)
-
-    @staticmethod
-    def _request_spans(requests):
-        spans, lo = [], 0
-        for r in requests:
-            spans.append((lo, lo + r.rows))
-            lo += r.rows
-        return spans
+        # the shared padded-bucket dispatch pipeline (chaos choke point,
+        # bucket pad, oversized-batch chunking, per-request slicing,
+        # completion) — see BatchExecutor; factored out so replica_pool
+        # runs the identical pipeline per replica
+        self._batch_core(requests)
 
     # -- hot swap ------------------------------------------------------------
     def swap_model(self, model_dir, backend="auto", drain_timeout_s=60.0):

@@ -1,0 +1,886 @@
+"""Multi-replica serving: a device-mesh replica pool over one shared queue.
+
+The single-device :class:`~.engine.InferenceEngine` tops out at one
+chip's dispatch rate.  This module is the Clipper (NSDI'17) layered
+answer scaled across ``jax.devices()``: N *replicas* — each one model
+copy with its params committed (``device_put``) to its own device and
+its own warmed bucket ladder — all fed from ONE shared priority
+:class:`~.request_queue.RequestQueue`, so the global serving policies
+stay global:
+
+* **admission** (typed backpressure, per-class capacity, deadline-aware
+  shedding) happens once, at the shared queue — a pool of 8 replicas
+  sheds with the same grammar as one engine, and the shed estimator
+  knows the rotation width (``RequestQueue.set_parallelism``);
+* **dispatch is pull-based least-loaded**: every replica runs its own
+  :class:`~.batcher.DynamicBatcher` worker against the shared queue and
+  claims a batch only when its previous dispatch finished, so work
+  flows to whichever replica is free — no assignment table to go stale
+  when a replica slows down.  A *gate* checked before every claim is
+  how a replica leaves the rotation without losing its thread, model,
+  or compiled buckets: breaker open (ejected), rolling-swap drain, or
+  autoscale quiesce (parked warm);
+* **health is per replica**: each replica owns a
+  :class:`~.resilient.CircuitBreaker` (consecutive fatal dispatches
+  eject exactly that replica; its half-open probe re-admits it) and a
+  supervised worker (a killed replica thread is restarted in place by
+  the shared :class:`~.resilient.WorkerSupervisor`, with the in-flight
+  batch failed typed, never hung — surviving replicas keep absorbing
+  the queue meanwhile);
+* **rolling hot swap**: :meth:`ReplicaPool.swap_model` drains + flips
+  ONE replica at a time under live traffic, so serving capacity never
+  reaches zero (contrast the engine's swap, which drains the whole
+  queue watermark first).  Requests in flight when the swap starts
+  finish on the version that claimed them; every answer is a complete
+  output of exactly one version;
+* **autoscale**: :meth:`autoscale_tick` consumes
+  ``serving.autoscale.desired_replicas`` (the PR-8 ``SLOMonitor``
+  signal) and activates/quiesces replicas within
+  ``[min_replicas, max_replicas]`` — scale-up immediate, scale-down
+  only after ``scale_down_after_s`` of consistently lower desire
+  (no-thrash hysteresis).  Quiesce = stop claiming, let in-flight
+  finish, park warm; reactivation is one flag flip away.
+
+Bitwise contract: rows are computed independently of batch neighbors,
+padding, and position (the engine's bucket-ladder contract), and every
+replica runs the same compiled program — so per-request results are
+bitwise-identical to the single-replica engine, whichever replica
+serves them.  ``tools/check_replica_pool.py`` gates this, the >=2.5x
+4-replica scaling floor, the never-zero-ready rolling swap, and the
+kill/eject/revive cycle on the forced-host-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+
+Telemetry: pool-level gauges ``serving.replica.pool_size`` /
+``.active`` / ``.ready``; per-replica ``serving.replica.state_<i>``
+(0 parked / 1 serving / 2 draining / 3 ejected / 4 dead),
+``.inflight_rows_<i>``, ``.breaker_<i>``, counters
+``.dispatches_<i>`` / ``.rows_<i>``; scale events on
+``serving.replica.scale_ups`` / ``.scale_downs`` with a
+``replica_scale`` record; per-replica flips during a rolling swap on
+``serving.replica.swapped`` with ``replica_swap`` records; and every
+execute span/record a replica emits carries its ``replica`` index, so
+a request's trace tree names the replica that served it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import core as _core
+from .. import observability as _obs
+from .batcher import CompletionTracker, DynamicBatcher
+from .engine import BatchExecutor, normalize_feed
+from .errors import ServingClosed, ServingDegraded, ServingError
+from .model_store import ModelStore
+from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
+from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
+
+__all__ = ["ReplicaPool"]
+
+_requests = _obs.counter("serving.requests")
+_swaps = _obs.counter("serving.swaps")
+_pool_size_gauge = _obs.gauge("serving.replica.pool_size")
+_active_gauge = _obs.gauge("serving.replica.active")
+_ready_gauge = _obs.gauge("serving.replica.ready")
+_scale_ups = _obs.counter("serving.replica.scale_ups")
+_scale_downs = _obs.counter("serving.replica.scale_downs")
+_replica_swapped = _obs.counter("serving.replica.swapped")
+
+#: serving.replica.state_<i> gauge codes
+REPLICA_STATES = {"parked": 0, "serving": 1, "draining": 2, "ejected": 3,
+                  "dead": 4}
+
+
+class _DevicePlace(_core.Place):
+    """A Place pinned to one concrete jax device — the pool hands each
+    replica its own entry from ``jax.devices()`` so the Program-backend
+    executor compiles and dispatches there."""
+
+    def __init__(self, device):
+        super().__init__(int(getattr(device, "id", 0)))
+        self._device = device
+
+    def jax_device(self):
+        return self._device
+
+    def __repr__(self):
+        return "_DevicePlace(%r)" % (self._device,)
+
+
+class _Replica:
+    """One model copy pinned to one device, with its own worker, breaker,
+    dispatch pipeline, and accounting.  All mutable scheduling state
+    (``active``/``draining``/``failed``) is flag-granular: the worker
+    reads it at the gate, the pool writes it — no lock on the hot path."""
+
+    def __init__(self, pool, index, device):
+        self.index = index
+        self.device = device
+        self.store = ModelStore(place=_DevicePlace(device),
+                                feed_shapes=pool._feed_shapes)
+        self.model = None
+        self.model_lock = threading.Lock()
+        self.active = True          # in the rotation (autoscale flag)
+        self.draining = False       # rolling-swap pause
+        self.failed = False         # worker dead past its restart budget
+        self.force_serve = False    # pool stop-drain: bypass the breaker
+        self.inflight_rows = 0      # rows the worker is dispatching NOW
+        self.dispatches = 0
+        self.rows_served = 0
+        # last instant the worker was observed PARKED at the gate — the
+        # drain handshake: the worker is single-threaded, so a park
+        # stamped after drain began proves no dispatch is in flight
+        self.parked_ts = 0.0
+        self.breaker = CircuitBreaker(
+            threshold=pool._breaker_threshold,
+            cooldown_s=pool._breaker_cooldown_s,
+            state_gauge=_obs.gauge("serving.replica.breaker_%d" % index))
+        self._core = BatchExecutor(
+            self._current_model, pool.batch_buckets,
+            queue_depth=pool._queue.depth, tags={"replica": index})
+        self.dispatcher = ResilientDispatcher(
+            self._execute, max_retries=pool._execute_retries,
+            breaker=self.breaker)
+        self.batcher = DynamicBatcher(
+            pool._queue, self.dispatcher, pool.max_batch_size,
+            pool.batch_timeout_ms / 1e3,
+            name="paddle-tpu-serving-replica%d" % index,
+            tracker=pool._tracker, gate=self._gate,
+            label="replica%d" % index)
+        self._inflight_gauge = _obs.gauge(
+            "serving.replica.inflight_rows_%d" % index)
+        self._state_gauge = _obs.gauge("serving.replica.state_%d" % index)
+        self._dispatch_counter = _obs.counter(
+            "serving.replica.dispatches_%d" % index)
+        self._rows_counter = _obs.counter("serving.replica.rows_%d" % index)
+
+    # -- model ---------------------------------------------------------------
+    def _current_model(self):
+        with self.model_lock:
+            return self.model
+
+    def load_model(self, dirname, backend):
+        """Load one model version PINNED to this replica's device:
+        Program backend dispatch is pinned via the executor's place, and
+        the loaded params are committed (``jax.device_put``) up front so
+        only the per-request feed ever moves at dispatch time; the AOT
+        backend's jitted executable is wrapped in a
+        ``jax.default_device`` scope instead (its weights are baked into
+        the executable, which compiles onto the device on first — i.e.
+        warmup — call)."""
+        import jax
+
+        model = self.store.load(dirname, backend=backend)
+        dev = self.device
+        if model.kind == "aot":
+            orig = model.predict_batch
+
+            def pinned(feed, _orig=orig, _dev=dev):
+                with jax.default_device(_dev):
+                    return _orig(feed)
+
+            model.predict_batch = pinned
+        else:
+            scope = getattr(model, "_scope", None)
+            if scope is not None:
+                # committed device_put BEFORE any dispatch (no fast-path
+                # binding exists yet, so mutating values is safe): params
+                # live on this replica's device from the first warmup run
+                for name, val in list(scope.vars.items()):
+                    try:
+                        scope.vars[name] = jax.device_put(val, dev)
+                    except (TypeError, ValueError):
+                        pass   # non-array aux var: the executor feeds it
+        return model
+
+    # -- worker hot path -----------------------------------------------------
+    def _gate(self):
+        """Checked by the worker before every queue claim; False parks it
+        (request stays in the shared queue for the other replicas)."""
+        if self.force_serve and self.model is not None and not self.failed:
+            # pool stop-drain: every queued request must reach a terminal
+            # outcome NOW — an open breaker still dispatches (the
+            # dispatcher fails requests typed if the path is truly dead,
+            # which beats leaving them hanging at a closed gate)
+            return True
+        if (not self.active or self.draining or self.failed
+                or self.model is None or not self.breaker.allow()):
+            self.parked_ts = time.perf_counter()
+            return False
+        return True
+
+    def _execute(self, requests):
+        """One dispatch ATTEMPT (retries/bisected sub-batches re-enter
+        here) with in-flight accounting around the shared pipeline."""
+        rows = sum(r.rows for r in requests)
+        self.inflight_rows += rows
+        self._inflight_gauge.set(self.inflight_rows)
+        try:
+            self._core(requests)
+            self.rows_served += rows
+            self._rows_counter.inc(rows)
+        finally:
+            # runs for Exception AND BaseException (kill_worker): the
+            # accounting is correct even as the worker thread dies
+            self.inflight_rows -= rows
+            self._inflight_gauge.set(self.inflight_rows)
+            self.dispatches += 1
+            self._dispatch_counter.inc()
+
+    # -- health --------------------------------------------------------------
+    def wait_quiescent(self, since, timeout):
+        """Block until this replica provably has no dispatch in flight:
+        its worker was seen parked at the (now closed) gate after
+        ``since``, or the worker thread is dead with nothing in flight.
+        False on timeout."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            if self.parked_ts > since:
+                return True
+            if not self.batcher.alive and self.inflight_rows == 0:
+                return True
+            if time.perf_counter() >= deadline:
+                return False
+            time.sleep(0.002)
+
+    def state(self):
+        if not self.batcher.alive or self.failed:
+            return "dead"
+        if self.breaker.state == "open":
+            return "ejected"
+        if self.draining:
+            return "draining"
+        if not self.active:
+            return "parked"
+        return "serving"
+
+    def ready(self):
+        """In rotation and able to claim work right now."""
+        return (self.active and not self.draining and not self.failed
+                and self.model is not None and self.batcher.alive
+                and self.breaker.state != "open")
+
+    def admissible(self):
+        """Could serve an admitted request soon: not permanently failed
+        and not breaker-open.  A draining/parked replica counts (the
+        drain ends, the autoscaler re-activates) and so does a dead
+        worker inside its restart budget (the supervisor revives it)."""
+        return (not self.failed and self.model is not None
+                and self.breaker.state != "open")
+
+    def publish(self):
+        self._state_gauge.set(REPLICA_STATES[self.state()])
+
+    def stats(self):
+        return {
+            "index": self.index,
+            "device": str(self.device),
+            "state": self.state(),
+            "ready": self.ready(),
+            "model_version": None if self.model is None
+            else self.model.version,
+            "worker_alive": self.batcher.alive,
+            "breaker": self.breaker.state,
+            "inflight_rows": self.inflight_rows,
+            "dispatches": self.dispatches,
+            "rows_served": self.rows_served,
+            "batches": self.batcher.batches,
+        }
+
+
+class ReplicaPool:
+    """Serve one saved inference model from N device-pinned replicas.
+
+    The external surface mirrors :class:`~.engine.InferenceEngine`
+    (``predict`` / ``predict_async`` / ``swap_model`` / ``health`` /
+    ``ready`` / ``stop`` / ``serve_metrics``), so anything written
+    against the engine — the SLO monitor, the load harness, a client —
+    scales to a pool by swapping the constructor.
+
+    Parameters (beyond the engine's, which keep their meaning)
+    ----------
+    replicas: pool size (model copies / devices).  Default: one per
+        entry of ``jax.devices()``.  Replica ``i`` is pinned to
+        ``devices[i % len(devices)]``.
+    devices: explicit device list (default ``jax.devices()``).
+    min_replicas / max_replicas: autoscale clamp on the ACTIVE rotation
+        (pool size itself is fixed at construction; a quiesced replica
+        parks warm).  Defaults: 1 / ``replicas``.
+    initial_replicas: rotation size at start (default: all).
+    scale_down_after_s: hysteresis — desired must stay below the active
+        count this long before a scale-down is applied (scale-UP is
+        immediate; overload hurts now, idle capacity only costs money).
+    """
+
+    def __init__(self, model_dir, replicas=None, devices=None,
+                 min_replicas=1, max_replicas=None, initial_replicas=None,
+                 batch_buckets=(2, 4, 8, 16), max_batch_size=None,
+                 batch_timeout_ms=0.0, queue_capacity=256,
+                 class_capacity=None, default_deadline_ms=None,
+                 backend="auto", feed_shapes=None, warmup=True,
+                 autostart=True, execute_retries=2, breaker_threshold=5,
+                 breaker_cooldown_s=1.0, supervise=True,
+                 worker_max_restarts=3, supervisor_interval_s=0.1,
+                 scale_down_after_s=5.0):
+        import jax
+
+        buckets = sorted(set(int(b) for b in batch_buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError("batch_buckets must be positive ints, got %r"
+                             % (batch_buckets,))
+        self.batch_buckets = tuple(buckets)
+        self.max_batch_size = int(max_batch_size or buckets[-1])
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.default_deadline_ms = default_deadline_ms
+        self._warmup = bool(warmup)
+        self._feed_shapes = feed_shapes
+        self._execute_retries = int(execute_retries)
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        devices = list(devices if devices is not None else jax.devices())
+        if not devices:
+            raise ServingError("no devices available for a replica pool")
+        n = int(replicas) if replicas is not None else len(devices)
+        if n < 1:
+            raise ValueError("replicas must be >= 1")
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = min(n, int(max_replicas)) if max_replicas \
+            else n
+        if self.min_replicas > self.max_replicas:
+            raise ValueError("min_replicas %d > max_replicas %d"
+                             % (self.min_replicas, self.max_replicas))
+        self.scale_down_after_s = float(scale_down_after_s)
+        self._state = "loading"
+        self._queue = RequestQueue(queue_capacity,
+                                   class_capacity=class_capacity)
+        self._tracker = CompletionTracker()
+        self._swap_lock = threading.Lock()
+        self._scale_lock = threading.Lock()
+        self._below_since = None      # scale-down hysteresis window start
+        self._below_peak = 0          # max desired seen inside the window
+        self._telemetry = _obs.get_telemetry()
+        self._metrics_server = None
+        self._replicas = [_Replica(self, i, devices[i % len(devices)])
+                          for i in range(n)]
+        for rep in self._replicas:
+            rep.model = rep.load_model(model_dir, backend)
+            if self._warmup:
+                rep.model.warmup(self.batch_buckets)
+        active0 = self.max_replicas if initial_replicas is None else max(
+            self.min_replicas, min(int(initial_replicas),
+                                   self.max_replicas))
+        for rep in self._replicas:
+            rep.active = rep.index < active0
+        # LIVE consumer count for the deadline-shed estimator: breaker
+        # ejects, autoscale parks, worker deaths/revivals all reflect at
+        # the next admission estimate with no bookkeeping at each flip
+        self._queue.set_parallelism(lambda: max(1, len(self._ready())))
+        self._supervisor = None
+        if supervise:
+            sup = WorkerSupervisor(interval_s=supervisor_interval_s,
+                                   max_restarts=worker_max_restarts,
+                                   on_give_up=self._on_worker_give_up)
+            for rep in self._replicas:
+                sup.watch(
+                    "replica%d" % rep.index,
+                    should_run=lambda r=rep: (r.batcher.started
+                                              and not r.batcher.stopping),
+                    is_alive=lambda r=rep: r.batcher.alive,
+                    restart=rep.batcher.restart,
+                    fail_pending=self._fail_pending_if_all_dead)
+            self._supervisor = sup
+        self._autoscaler_stop = threading.Event()
+        self._autoscaler = None
+        _pool_size_gauge.set(n)
+        self._state = "ready"
+        self._publish()
+        if autostart:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start (or revive) every replica worker.  Like the engine's
+        ``start``, an operator call grants a fresh restart budget to any
+        replica that comes back alive."""
+        for rep in self._replicas:
+            if not rep.batcher.alive:
+                rep.batcher.start()
+                if rep.batcher.alive:
+                    rep.failed = False
+                    if self._supervisor is not None:
+                        self._supervisor.reset("replica%d" % rep.index)
+        if self._supervisor is not None:
+            self._supervisor.start()
+        self._publish()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the pool.  ``drain=True`` answers everything queued first
+        (every replica participates in the drain — gates open, including
+        parked ones); new requests are rejected with ``ServingClosed``
+        from the moment the stop begins.  Serializes with an in-flight
+        rolling swap on the swap lock."""
+        with self._swap_lock:
+            if self._state == "stopped":
+                return
+            self._state = "stopped"
+            self.stop_autoscaler()
+            self._queue.close()
+            for rep in self._replicas:
+                # open every gate: the drain wants ALL warm capacity, and
+                # a parked worker must observe `stopping` and exit
+                rep.active = True
+                rep.draining = False
+                rep.force_serve = True
+            if drain and (self._supervisor is not None
+                          or any(r.batcher.alive
+                                 for r in self._replicas)):
+                # drain POOL-level first, against the shared watermark:
+                # per-batcher stop fails queue leftovers once ITS worker
+                # is gone, which would shed requests the other replicas
+                # were about to answer.  The supervisor is still running
+                # here, so a replica dying mid-drain is restarted (or its
+                # give-up tick fails the backlog) and the watermark
+                # always lands; with neither a supervisor nor a live
+                # worker the wait is skipped and the per-batcher stop
+                # fails the leftovers instead.
+                self._tracker.wait_for(self._queue.last_seq(), timeout)
+            for rep in self._replicas:
+                stopped = rep.batcher.stop(drain=drain, timeout=timeout)
+                if stopped and rep.model is not None:
+                    rep.model.close()
+                # a wedged worker keeps its model open (same forced-
+                # shutdown edge as the engine: never close an executable
+                # under a running batch)
+            if self._supervisor is not None:
+                self._supervisor.stop()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+                self._metrics_server = None
+            self._publish()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- worker failure ------------------------------------------------------
+    def _on_worker_give_up(self, worker_name):
+        idx = int(worker_name.replace("replica", ""))
+        rep = self._replicas[idx]
+        rep.failed = True
+        # self-healing rotation: replace the lost capacity with a parked
+        # warm replica when one exists (the autoscaler's budget still
+        # bounds the rotation — this substitutes, it does not grow)
+        if rep.active:
+            for cand in self._replicas:
+                if not cand.active and not cand.failed \
+                        and cand.batcher.alive:
+                    cand.active = True
+                    self._emit_scale(len(self._active()), "replace_failed")
+                    break
+        self._publish()
+
+    def _fail_pending_if_all_dead(self):
+        """Supervisor give-up tick: only drain the SHARED queue once no
+        replica can ever serve it — one dead replica must not fail
+        requests its siblings will happily answer."""
+        if any(r.batcher.alive and not r.failed for r in self._replicas):
+            return
+        self._queue.drain_remaining(
+            lambda r: ServingDegraded(
+                "every pool replica is dead past its restart budget"),
+            on_fail=lambda r: self._tracker.mark_done([r]))
+
+    # -- introspection -------------------------------------------------------
+    def _active(self):
+        return [r for r in self._replicas if r.active]
+
+    def _ready(self):
+        return [r for r in self._replicas if r.ready()]
+
+    def active_replicas(self):
+        """Rotation size (autoscale's unit): replicas currently allowed
+        to claim work (draining/ejected/dead ones still count toward the
+        rotation — they are impaired, not descaled)."""
+        return len(self._active())
+
+    def ready_replicas(self):
+        """Replicas able to claim work RIGHT NOW (active, not draining,
+        worker alive, breaker not open).  The rolling-swap invariant the
+        gate asserts: this never reaches 0 during a swap of a >=2
+        replica pool."""
+        return len(self._ready())
+
+    @property
+    def replicas(self):
+        return len(self._replicas)
+
+    @property
+    def state(self):
+        """"ready" | "degraded" | "swapping" | "stopped" — ``degraded``
+        is derived: lifecycle-ready but at least one IN-ROTATION replica
+        is impaired (dead worker past budget or breaker open)."""
+        if self._state == "ready":
+            if any(r.failed or r.breaker.state == "open"
+                   for r in self._active()):
+                return "degraded"
+        return self._state
+
+    def ready(self):
+        """Load-balancer truth: at least one replica serves (or provably
+        will within the supervisor's restart budget)."""
+        if self._state not in ("ready", "swapping"):
+            return False
+        return any(r.admissible() for r in self._replicas)
+
+    def replica_stats(self):
+        return [r.stats() for r in self._replicas]
+
+    def health(self):
+        self._publish()
+        versions = sorted({r.model.version for r in self._replicas
+                           if r.model is not None})
+        h = {
+            "state": self.state,
+            "ready": self.ready(),
+            "replicas": len(self._replicas),
+            "active_replicas": self.active_replicas(),
+            "ready_replicas": self.ready_replicas(),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            # one version in steady state; two mid-rolling-swap (or after
+            # a failed swap left the pool mixed — retry completes it)
+            "model_versions": versions,
+            "model_version": versions[-1] if versions else None,
+            "batch_buckets": list(self.batch_buckets),
+            "max_batch_size": self.max_batch_size,
+            "queue_depth": self._queue.depth(),
+            "queue_capacity": self._queue.capacity,
+            "class_depths": self._queue.class_depths(),
+            "class_rows": self._queue.class_rows(),
+            "service_rate_rows_per_s": self._queue.service_rate,
+            "requests": self._queue.last_seq(),
+            "batches": sum(r.batcher.batches for r in self._replicas),
+            "per_replica": self.replica_stats(),
+        }
+        if self._supervisor is not None:
+            h["workers"] = self._supervisor.stats()
+        return h
+
+    def serve_metrics(self, host="127.0.0.1", port=0):
+        """Live ``/metrics`` + ``/healthz`` endpoint for the POOL (same
+        contract as the engine's): healthz serves :meth:`health` and
+        answers 503 while :meth:`ready` is False."""
+        srv = self._metrics_server
+        if srv is not None and srv.running:
+            return srv
+        self._metrics_server = _obs.MetricsServer(
+            host=host, port=port, health_fn=self.health).start()
+        return self._metrics_server
+
+    @property
+    def feed_names(self):
+        m = self._spec_model()
+        return [] if m is None else list(m.feed_names)
+
+    @property
+    def fetch_names(self):
+        m = self._spec_model()
+        return [] if m is None else list(m.fetch_names)
+
+    @property
+    def model_version(self):
+        versions = [r.model.version for r in self._replicas
+                    if r.model is not None]
+        return max(versions) if versions else None
+
+    def _spec_model(self):
+        for rep in self._replicas:
+            m = rep._current_model()
+            if m is not None:
+                return m
+        return None
+
+    def _publish(self):
+        _active_gauge.set(len(self._active()))
+        _ready_gauge.set(len(self._ready()))
+        for rep in self._replicas:
+            rep.publish()
+
+    # -- request admission ---------------------------------------------------
+    def predict_async(self, feed, deadline_ms=None, priority=None):
+        """Admit one request into the SHARED queue; whichever ready
+        replica claims it serves it.  Same error contract as the
+        engine's ``predict_async``; ``ServingDegraded`` only when no
+        replica could ever serve it (all dead past budget or ejected)."""
+        if self._state == "stopped":
+            raise ServingClosed("replica pool is stopped")
+        if self._state == "loading":
+            raise ServingClosed("replica pool is still loading")
+        spec_model = self._spec_model()
+        if spec_model is None:
+            raise ServingError("replica pool has no loaded model")
+        if not any(r.admissible() for r in self._replicas):
+            raise ServingDegraded(
+                "no replica can serve: all dead past restart budget or "
+                "circuit-broken; pool degraded")
+        arrays, rows = normalize_feed(spec_model, feed, self.max_batch_size)
+        if priority is not None and priority not in PRIORITY_CLASSES:
+            raise ServingError("unknown priority class %r (know %s)"
+                               % (priority, PRIORITY_CLASSES))
+        ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        deadline = None if ms is None else time.perf_counter() + ms / 1e3
+        req = self._queue.put(
+            Request(arrays, rows, deadline=deadline, priority=priority))
+        _requests.inc()
+        return req
+
+    def predict(self, feed, deadline_ms=None, priority=None, timeout=None):
+        return self.predict_async(
+            feed, deadline_ms=deadline_ms, priority=priority).result(
+            timeout=timeout)
+
+    def drain(self, timeout=None):
+        """Block until everything admitted so far has reached a terminal
+        outcome (the pool-wide exact watermark).  False on timeout."""
+        return self._tracker.wait_for(self._queue.last_seq(), timeout)
+
+    # -- rolling hot swap ----------------------------------------------------
+    def swap_model(self, model_dir, backend="auto", drain_timeout_s=60.0):
+        """ROLLING hot swap: for each replica in turn — load + warm the
+        new version on ITS device while every other replica keeps
+        serving, close the replica's gate, wait until it provably has no
+        dispatch in flight, flip, reopen.  Capacity never reaches zero
+        for a >=2 replica pool: exactly one replica is ever out, and in
+        a PARTIAL rotation (autoscale parked the rest) a parked warm
+        sibling is temporarily opened as cover while the sole ready
+        replica drains.
+
+        Version semantics: a request finishes on the version of the
+        replica that claimed it, so requests in flight across the swap
+        may be answered by either version — each answer is a complete
+        output of exactly one version.  If a replica's drain times out
+        the swap raises, leaving earlier replicas on the new version
+        and later ones on the old (pool reports both in
+        ``health()["model_versions"]``); re-running the swap completes
+        the rollout.  Returns the new version number."""
+        if self._state == "stopped":
+            raise ServingClosed("replica pool is stopped")
+        with self._swap_lock:
+            if self._state == "stopped":   # stop() won the lock first
+                raise ServingClosed("replica pool is stopped")
+            prev_state, self._state = self._state, "swapping"
+            new_version = None
+            try:
+                for rep in self._replicas:
+                    new = rep.load_model(model_dir, backend)
+                    ref = self._spec_model()
+                    # in-flight requests were normalized against the
+                    # serving specs; the new version must accept exactly
+                    # the same feeds or they could poison on it
+                    if (new.feed_names != ref.feed_names
+                            or new.feed_specs != ref.feed_specs):
+                        new.close()
+                        raise ServingError(
+                            "swap rejected: new model feeds %s %s != "
+                            "serving feeds %s %s"
+                            % (new.feed_names, new.feed_specs,
+                               ref.feed_names, ref.feed_specs))
+                    if self._warmup:
+                        new.warmup(self.batch_buckets)
+                    # partial rotation (autoscale parked the rest):
+                    # draining the SOLE ready replica would zero serving
+                    # capacity even though warm siblings sit parked —
+                    # open one as cover for this replica's drain window,
+                    # and park it again after (net rotation unchanged)
+                    cover = None
+                    if rep.ready() and not any(
+                            o.ready() for o in self._replicas
+                            if o is not rep):
+                        for cand in self._replicas:
+                            if (cand is not rep and not cand.active
+                                    and cand.admissible()
+                                    and cand.batcher.alive):
+                                cand.active = True
+                                cover = cand
+                                break
+                    # close the gate FIRST, then stamp: a park observed
+                    # after `since` was necessarily a park at a closed
+                    # gate, so the single-threaded worker cannot start
+                    # another dispatch until the drain flag clears
+                    rep.draining = True
+                    since = time.perf_counter()
+                    self._publish()
+                    try:
+                        if not rep.wait_quiescent(since, drain_timeout_s):
+                            new.close()
+                            raise ServingError(
+                                "rolling swap: replica %d drain timed out "
+                                "after %.1fs (%d rows in flight)"
+                                % (rep.index, drain_timeout_s,
+                                   rep.inflight_rows))
+                        with rep.model_lock:
+                            old, rep.model = rep.model, new
+                    finally:
+                        rep.draining = False
+                        if cover is not None:
+                            cover.active = False
+                        self._publish()
+                    # the replica was parked at a closed gate when we
+                    # flipped: the old version is idle — safe to close
+                    old.close()
+                    new_version = new.version
+                    _replica_swapped.inc()
+                    if self._telemetry.recording:
+                        self._telemetry.emit({
+                            "type": "replica_swap", "ts": time.time(),
+                            "source": "serving", "replica": rep.index,
+                            "from_version": old.version,
+                            "to_version": new.version,
+                            "ready_replicas": self.ready_replicas(),
+                        })
+            finally:
+                self._state = prev_state
+        _swaps.inc()
+        if self._telemetry.recording:
+            self._telemetry.emit({
+                "type": "model_swap", "ts": time.time(), "source": "serving",
+                "rolling": True, "replicas": len(self._replicas),
+                "to_version": new_version, "model_dir": model_dir,
+            })
+        return new_version
+
+    # -- autoscale -----------------------------------------------------------
+    def set_active_replicas(self, n, reason="manual"):
+        """Resize the rotation to ``n`` (clamped to
+        ``[min_replicas, max_replicas]``): activate parked replicas in
+        index order, or quiesce active ones (stop claiming, let
+        in-flight work finish, park warm — their model, device params,
+        and compiled buckets stay resident).  Health-aware on both
+        sides: scale-up counts only HEALTHY (non-failed) actives toward
+        the target, so a dead-past-budget replica in the rotation is
+        backfilled by a parked spare instead of silently shrinking
+        capacity; scale-down parks failed actives first, then draining
+        ones (already not claiming), then the highest-index healthy —
+        quiescing must never park the last healthy replica while a dead
+        one squats in the rotation.  Returns the applied rotation
+        size."""
+        with self._scale_lock:
+            n = max(self.min_replicas, min(int(n), self.max_replicas))
+            before = len(self._active())
+            healthy = sum(1 for r in self._active() if not r.failed)
+            if n > healthy:
+                want = n - healthy
+                grew = False
+                for rep in self._replicas:
+                    if want == 0:
+                        break
+                    if not rep.active and not rep.failed:
+                        rep.active = True
+                        grew = True
+                        want -= 1
+                if grew:
+                    _scale_ups.inc()
+            active = self._active()
+            if n < len(active):
+                excess = len(active) - n
+                # park the impaired first (dead past budget, breaker
+                # open, mid-drain — none of them is claiming anyway),
+                # then the highest-index healthy: quiescing must never
+                # park serving capacity while impaired replicas squat
+                impaired = [r for r in active
+                            if r.failed or r.breaker.state == "open"
+                            or r.draining]
+                victims = impaired + [r for r in reversed(active)
+                                      if r not in impaired]
+                for rep in victims[:excess]:
+                    rep.active = False
+                _scale_downs.inc()
+            now_active = len(self._active())
+            self._publish()
+            if now_active != before:
+                self._emit_scale(now_active, reason, before=before)
+            return now_active
+
+    def _emit_scale(self, to_n, reason, before=None):
+        if self._telemetry.recording:
+            self._telemetry.emit({
+                "type": "replica_scale", "ts": time.time(),
+                "source": "serving", "from": before, "to": to_n,
+                "reason": reason, "ready_replicas": self.ready_replicas(),
+            })
+
+    def autoscale_tick(self, desired=None, now=None):
+        """Apply one autoscale decision.  ``desired`` defaults to the
+        live ``serving.autoscale.desired_replicas`` gauge (published by
+        :class:`~paddle_tpu.observability.SLOMonitor.evaluate`).
+        Scale-UP applies immediately; scale-DOWN only once desired has
+        stayed below the active count for ``scale_down_after_s``
+        straight (one recovered window must not thrash the rotation),
+        and then only to the HIGHEST desired seen inside that window.
+        Returns the rotation size after the tick."""
+        if desired is None:
+            v = _obs.gauge("serving.autoscale.desired_replicas").value
+            if v is None:
+                return self.active_replicas()
+            desired = v
+        desired = max(self.min_replicas,
+                      min(int(desired), self.max_replicas))
+        now = time.perf_counter() if now is None else now
+        active = self.active_replicas()
+        if desired > active:
+            self._below_since = None
+            return self.set_active_replicas(desired, reason="autoscale_up")
+        if desired < active:
+            if self._below_since is None:
+                self._below_since = now
+                self._below_peak = desired
+            else:
+                self._below_peak = max(self._below_peak, desired)
+            if now - self._below_since >= self.scale_down_after_s:
+                target = self._below_peak
+                self._below_since = None
+                return self.set_active_replicas(
+                    target, reason="autoscale_down")
+            return active
+        self._below_since = None
+        return active
+
+    def start_autoscaler(self, monitor=None, interval_s=None):
+        """Run the autoscale loop on a daemon thread: each tick either
+        evaluates ``monitor`` (an
+        :class:`~paddle_tpu.observability.SLOMonitor`, typically
+        constructed with ``engine=pool``) and applies its
+        ``desired_replicas``, or — without a monitor — consumes the
+        latest published gauge value."""
+        if self._autoscaler is not None and self._autoscaler.is_alive():
+            return self
+        period = float(interval_s) if interval_s is not None else (
+            monitor.window_s if monitor is not None else 1.0)
+        self._autoscaler_stop.clear()
+
+        def loop():
+            while not self._autoscaler_stop.wait(period):
+                try:
+                    desired = None
+                    if monitor is not None:
+                        desired = monitor.evaluate()["desired_replicas"]
+                    self.autoscale_tick(desired)
+                except Exception:
+                    pass   # scaling must outlive a flaky health probe
+
+        self._autoscaler = threading.Thread(
+            target=loop, name="paddle-tpu-replica-autoscaler", daemon=True)
+        self._autoscaler.start()
+        return self
+
+    def stop_autoscaler(self, timeout=2.0):
+        self._autoscaler_stop.set()
+        t = self._autoscaler
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._autoscaler = None

@@ -248,6 +248,7 @@ class RequestQueue:
         self._seq = 0
         self._closed = False
         self._service_rate = None    # EMA rows/second, None until warm
+        self._parallelism = 1        # concurrent consumers (replica pool)
         self._depth_gauge = depth_gauge if depth_gauge is not None else _queue_depth
         self._full_counter = (full_counter if full_counter is not None
                               else _queue_full)
@@ -278,13 +279,41 @@ class RequestQueue:
 
     @property
     def service_rate(self):
-        """EMA rows/second, or None while cold."""
+        """EMA rows/second of ONE consumer's dispatches, or None while
+        cold.  (Per-replica by construction: each dispatch is timed
+        individually, so a pool of N replicas feeding this EMA still
+        measures single-replica speed — which is exactly what the
+        autoscale formula wants.  The ADMISSION estimate multiplies by
+        :meth:`set_parallelism`'s count instead.)"""
         return self._service_rate
+
+    def set_parallelism(self, n):
+        """How many consumers drain this queue concurrently (a replica
+        pool's ready-replica count; 1 for a single engine).  The
+        deadline-shed admission estimate divides backlog by
+        ``service_rate * parallelism`` — without this, a pool's
+        admission would overestimate queue wait N-fold and shed
+        deadline-carrying requests the rotation could easily serve.
+        Accepts an int or a CALLABLE returning the live count, so a
+        dynamic consumer set (breaker ejects, autoscale parks, worker
+        deaths and revivals) is read at each estimate instead of
+        maintained at every state flip."""
+        with self._lock:
+            self._parallelism = n if callable(n) else max(1, int(n))
+
+    def _parallelism_locked(self):
+        p = self._parallelism
+        if callable(p):
+            try:
+                p = p()
+            except Exception:  # noqa: BLE001 — estimator must not shed on
+                p = 1          # a health-probe fault; fall conservative
+        return max(1, int(p))
 
     def estimated_wait_s(self, priority=DEFAULT_PRIORITY):
         """Expected queue wait for a request admitted NOW at ``priority``:
         rows queued at the same or higher priority over the measured
-        service rate.  None while the estimator is cold."""
+        aggregate service rate.  None while the estimator is cold."""
         with self._lock:
             return self._estimated_wait_locked(priority)
 
@@ -296,7 +325,7 @@ class RequestQueue:
             ahead += self._lane_rows[cls]
             if cls == priority:
                 break
-        return ahead / self._service_rate
+        return ahead / (self._service_rate * self._parallelism_locked())
 
     # -- admission -----------------------------------------------------------
     def put(self, request):
@@ -329,13 +358,15 @@ class RequestQueue:
                 if est is not None and now + est > request.deadline:
                     self._shed_counter.inc()
                     _rejected_counters[cls].inc()
+                    par = self._parallelism_locked()
                     raise ServingOverloaded(
                         "deadline %.0fms away but estimated %s-class "
                         "queue wait is %.0fms (%d rows ahead at %.0f "
-                        "rows/s); shed at admission"
+                        "rows/s x %d consumers); shed at admission"
                         % (max(0.0, (request.deadline - now)) * 1e3, cls,
-                           est * 1e3, int(round(est * self._service_rate)),
-                           self._service_rate))
+                           est * 1e3,
+                           int(round(est * self._service_rate * par)),
+                           self._service_rate, par))
             self._seq += 1
             request.seq = self._seq
             if request.trace is None:

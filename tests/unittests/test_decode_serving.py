@@ -280,3 +280,131 @@ class TestEngineGenerate:
     def test_engine_without_decode_model_refuses_generate(self, tmp_path):
         with pytest.raises(ValueError, match="model_dir"):
             serving.InferenceEngine()
+
+
+# -- sampling (temperature / top-k / carried PRNG key) -----------------------
+
+class TestSampling:
+    def test_greedy_default_unchanged_and_deterministic(self, decode_model):
+        rng = np.random.RandomState(3)
+        p = _prompts(1, rng)[0]
+        sched = serving.DecodeScheduler(decode_model, _cfg())
+        a = sched.generate(p, timeout=120)
+        b = sched.generate(p, timeout=120, temperature=0.0, seed=123)
+        sched.stop()
+        # temperature 0 is argmax whatever the seed; None defaults to it
+        assert a.tobytes() == b.tobytes()
+
+    def test_same_seed_reproduces_other_seed_differs(self, decode_model):
+        rng = np.random.RandomState(4)
+        p = _prompts(1, rng, lo=8, hi=9)[0]
+        sched = serving.DecodeScheduler(decode_model, _cfg())
+        a = sched.generate(p, timeout=120, temperature=1.0, seed=7)
+        b = sched.generate(p, timeout=120, temperature=1.0, seed=7)
+        outs = [sched.generate(p, timeout=120, temperature=1.0, seed=s)
+                for s in range(8)]
+        sched.stop()
+        assert a.tobytes() == b.tobytes(), "same (seed, prompt) differs"
+        assert len({o.tobytes() for o in outs}) > 1, (
+            "8 seeds all produced identical sampled sequences")
+
+    def test_sampling_independent_of_batch_composition(self, decode_model):
+        """The carried key is folded with the token's absolute position,
+        so a sampled request decodes identically whether it shares the
+        step with neighbors (continuous batching) or runs alone."""
+        rng = np.random.RandomState(5)
+        p = _prompts(1, rng, lo=10, hi=11)[0]
+        solo = serving.DecodeScheduler(decode_model, _cfg(max_active=1))
+        want = solo.generate(p, timeout=120, temperature=0.9, seed=11)
+        solo.stop()
+        packed = serving.DecodeScheduler(decode_model, _cfg())
+        futs = [packed.submit(q, temperature=0.7, seed=100 + i)
+                for i, q in enumerate(_prompts(3, rng))]
+        got = packed.generate(p, timeout=120, temperature=0.9, seed=11)
+        for f in futs:
+            f.result(timeout=120)
+        packed.stop()
+        assert got.tobytes() == want.tobytes()
+
+    def test_top_k_and_validation(self, decode_model):
+        rng = np.random.RandomState(6)
+        p = _prompts(1, rng)[0]
+        sched = serving.DecodeScheduler(
+            decode_model, _cfg(num_slots=2, top_k=5))
+        greedy = sched.generate(p, timeout=120)
+        sampled = sched.generate(p, timeout=120, temperature=0.8, seed=2)
+        with pytest.raises(serving.ServingError, match="temperature"):
+            sched.submit(p, temperature=-0.5)
+        sched.stop()
+        assert greedy.shape == sampled.shape
+        with pytest.raises(ValueError, match="top_k"):
+            serving.DecodeConfig(top_k=0)
+        with pytest.raises(ValueError, match="default_temperature"):
+            serving.DecodeConfig(default_temperature=-1.0)
+
+    def test_default_temperature_config(self, decode_model):
+        rng = np.random.RandomState(7)
+        p = _prompts(1, rng, lo=6, hi=7)[0]
+        sched = serving.DecodeScheduler(
+            decode_model, _cfg(default_temperature=1.0))
+        # seedless sampling defaults its seed to the admission seq:
+        # stable within a run, so two identical submits may differ
+        # (different seqs) but an explicit seed pins them
+        a = sched.generate(p, timeout=120, seed=5)
+        b = sched.generate(p, timeout=120, seed=5)
+        g = sched.generate(p, timeout=120, temperature=0.0)
+        sched.stop()
+        assert a.tobytes() == b.tobytes()
+        assert g.shape == a.shape
+
+
+# -- prefill retry (the replayable decode leg) -------------------------------
+
+class TestPrefillRetry:
+    def test_transient_prefill_fault_retried_to_success(self, decode_model):
+        from paddle_tpu.testing import faults
+
+        rng = np.random.RandomState(8)
+        p = _prompts(1, rng)[0]
+        sched = serving.DecodeScheduler(decode_model, _cfg())
+        want = sched.generate(p, timeout=120)
+        r0 = obs.counter("serving.decode.prefill_retries").value
+        with faults.flaky_execute(times=2) as fired:
+            got = sched.generate(p, timeout=120)
+        sched.stop()
+        assert fired[0] == 2
+        assert got.tobytes() == want.tobytes(), (
+            "retried prefill changed the generated tokens")
+        assert obs.counter("serving.decode.prefill_retries").value == r0 + 2
+
+    def test_fatal_prefill_fault_fails_typed_without_retry(self, decode_model):
+        from paddle_tpu.testing import faults
+
+        rng = np.random.RandomState(9)
+        p = _prompts(1, rng)[0]
+        sched = serving.DecodeScheduler(decode_model, _cfg())
+        r0 = obs.counter("serving.decode.prefill_retries").value
+        with faults.poison_request(lambda r: True):
+            fut = sched.submit(p)
+            with pytest.raises(ValueError):
+                fut.result(timeout=120)
+        # fatal (non-transient) faults are not retried
+        assert obs.counter("serving.decode.prefill_retries").value == r0
+        # and the scheduler still serves afterwards
+        out = sched.generate(p, timeout=120)
+        sched.stop()
+        assert out.shape[0] >= 1
+
+    def test_retry_exhaustion_fails_typed(self, decode_model):
+        from paddle_tpu.testing import faults
+
+        rng = np.random.RandomState(10)
+        p = _prompts(1, rng)[0]
+        sched = serving.DecodeScheduler(decode_model, _cfg())
+        with faults.flaky_execute(times=None):   # every attempt faults
+            fut = sched.submit(p)
+            with pytest.raises(faults.FaultInjected):
+                fut.result(timeout=120)
+        out = sched.generate(p, timeout=120)     # scheduler survived
+        sched.stop()
+        assert out.shape[0] >= 1

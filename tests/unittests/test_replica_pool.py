@@ -1,0 +1,439 @@
+"""Multi-replica serving: ReplicaPool semantics — shared admission,
+rotation scaling + autoscale hysteresis, rolling swap, degradation
+surfaces, and the shared completion watermark.  The end-to-end scaling /
+bitwise / swap-under-traffic / kill-revive gate lives in
+test_replica_gate.py (tools/check_replica_pool.py); these are the unit
+half.  The tests conftest forces an 8-device virtual CPU mesh, so pools
+here really pin replicas to distinct devices.
+"""
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+
+WIDTH = 8
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("replica_model") / "m")
+    _save_model(d, seed=5)
+    return d
+
+
+def _save_model(dirname, seed, width=WIDTH, feed_name="x"):
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name=feed_name, shape=[width], dtype="float32")
+        out = fluid.layers.fc(x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        np.random.seed(seed)
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, [feed_name], [out], exe,
+                                      main_program=main)
+    return dirname
+
+
+def _pool(model_dir, **kw):
+    base = dict(replicas=2, batch_buckets=(2, 4), batch_timeout_ms=0.5,
+                warmup=False, supervisor_interval_s=0.05)
+    base.update(kw)
+    return serving.ReplicaPool(model_dir, **base)
+
+
+# -- completion tracker ------------------------------------------------------
+
+class TestCompletionTracker:
+    def test_out_of_order_watermark(self):
+        t = serving.CompletionTracker()
+
+        class R:
+            def __init__(self, seq):
+                self.seq = seq
+
+        t.mark_done([R(3), R(2)])
+        assert t.completed_seq == 0          # seq 1 still outstanding
+        t.mark_done([R(1)])
+        assert t.completed_seq == 3          # contiguous prefix advanced
+        assert t.wait_for(3, timeout=0.1)
+        assert not t.wait_for(4, timeout=0.05)
+        t.mark_done([R(5)])
+        assert t.completed_seq == 3          # a gap never advances it
+        t.mark_done([R(4)])
+        assert t.completed_seq == 5
+
+    def test_shared_across_markers(self):
+        t = serving.CompletionTracker()
+
+        class R:
+            def __init__(self, seq):
+                self.seq = seq
+
+        # two "replicas" completing interleaved seqs against one tracker
+        done = threading.Event()
+
+        def other():
+            t.mark_done([R(2)])
+            done.set()
+
+        t.mark_done([R(1)])
+        threading.Thread(target=other).start()
+        assert t.wait_for(2, timeout=5)
+        assert done.is_set()
+
+
+# -- serving semantics -------------------------------------------------------
+
+class TestPoolServing:
+    def test_bitwise_vs_engine_and_fanout(self, model_dir):
+        rng = np.random.RandomState(0)
+        payloads = [rng.rand(rng.randint(1, 4), WIDTH).astype(np.float32)
+                    for _ in range(16)]
+        eng = serving.InferenceEngine(model_dir, batch_buckets=(2, 4),
+                                      supervise=False)
+        want = [eng.predict({"x": p}) for p in payloads]
+        eng.stop()
+        with _pool(model_dir, replicas=2, warmup=True) as pool:
+            futs = [pool.predict_async({"x": p}) for p in payloads]
+            got = [f.result(timeout=60) for f in futs]
+            stats = pool.replica_stats()
+        for g, w in zip(got, want):
+            for a, b in zip(g, w):
+                assert a.tobytes() == b.tobytes()
+        devs = {s["device"] for s in stats}
+        assert len(devs) == 2, "replicas share a device: %s" % stats
+
+    def test_health_surface(self, model_dir):
+        with _pool(model_dir, replicas=3, initial_replicas=2) as pool:
+            h = pool.health()
+            assert h["replicas"] == 3
+            assert h["active_replicas"] == 2
+            assert h["ready_replicas"] == 2
+            assert h["model_versions"] == [1]
+            assert len(h["per_replica"]) == 3
+            states = [r["state"] for r in h["per_replica"]]
+            assert states.count("serving") == 2
+            assert states.count("parked") == 1
+            assert h["state"] == "ready" and h["ready"]
+            assert pool.feed_names == ["x"]
+            assert obs.gauge("serving.replica.pool_size").value == 3
+            assert obs.gauge("serving.replica.active").value == 2
+
+    def test_admission_contract(self, model_dir):
+        X = np.zeros((1, WIDTH), np.float32)
+        pool = _pool(model_dir, autostart=False, queue_capacity=4,
+                     supervise=False)
+        try:
+            with pytest.raises(serving.ServingError):
+                pool.predict_async({"x": X}, priority="nope")
+            with pytest.raises(serving.ServingError):
+                pool.predict_async({"y": X})
+            for _ in range(4):
+                pool.predict_async({"x": X})
+            with pytest.raises(serving.ServingQueueFull):
+                pool.predict_async({"x": X})
+        finally:
+            pool.stop(drain=False)
+        with pytest.raises(serving.ServingClosed):
+            pool.predict_async({"x": X})
+
+    def test_stop_drain_answers_backlog(self, model_dir):
+        X = np.zeros((1, WIDTH), np.float32)
+        pool = _pool(model_dir, autostart=False)
+        futs = [pool.predict_async({"x": X}) for _ in range(8)]
+        pool.start()
+        pool.stop(drain=True, timeout=60)
+        for f in futs:
+            assert f.result(timeout=1)[0].shape == (1, 4)
+
+    def test_degraded_when_no_replica_admissible(self, model_dir):
+        X = np.zeros((1, WIDTH), np.float32)
+        pool = _pool(model_dir, autostart=False, supervise=False)
+        try:
+            for rep in pool._replicas:
+                rep.failed = True
+            assert not pool.ready()
+            with pytest.raises(serving.ServingDegraded):
+                pool.predict_async({"x": X})
+        finally:
+            for rep in pool._replicas:
+                rep.failed = False
+            pool.stop(drain=False)
+
+    def test_breaker_open_ejects_from_rotation(self, model_dir):
+        # cooldown far beyond the test: the breaker must stay OPEN for
+        # the whole rotation check (a short cooldown would half-open and
+        # legitimately re-admit the healthy replica via its probe)
+        with _pool(model_dir, replicas=2, breaker_cooldown_s=60.0) as pool:
+            rep = pool._replicas[0]
+            since = time.perf_counter()
+            for _ in range(pool._breaker_threshold):
+                rep.breaker.record_fatal()
+            assert rep.breaker.state == "open"
+            # the worker may have passed the gate BEFORE the trip and be
+            # sitting in its 50ms queue pop: wait until it is provably
+            # parked at the now-closed gate (the drain handshake), or it
+            # could legitimately claim one more batch whose success
+            # would re-close the breaker
+            assert rep.wait_quiescent(since, timeout=5)
+            assert rep.state() == "ejected"
+            assert pool.ready_replicas() == 1
+            assert pool.state == "degraded"   # impaired but serving
+            assert pool.ready()               # sibling still admissible
+            # the ejected replica claims nothing while open
+            before = rep.dispatches
+            X = np.zeros((1, WIDTH), np.float32)
+            for _ in range(6):
+                pool.predict({"x": X}, timeout=30)
+            assert rep.dispatches == before
+            # half-open probe re-admits it
+            rep.breaker.record_success()
+            assert pool.ready_replicas() == 2
+            assert pool.state == "ready"
+
+
+# -- rotation scaling + autoscale -------------------------------------------
+
+class TestScaling:
+    def test_set_active_replicas_clamps_and_parks(self, model_dir):
+        X = np.zeros((1, WIDTH), np.float32)
+        with _pool(model_dir, replicas=4, min_replicas=1) as pool:
+            assert pool.set_active_replicas(9) == 4    # clamp high
+            assert pool.set_active_replicas(0) == 1    # clamp low
+            assert pool.active_replicas() == 1
+            parked = pool._replicas[1]
+            assert parked.state() == "parked"
+            # parked = warm: worker alive, model resident, zero claims
+            assert parked.batcher.alive
+            assert parked.model is not None
+            before = parked.dispatches
+            for _ in range(4):
+                pool.predict({"x": X}, timeout=30)
+            assert parked.dispatches == before
+            s0 = obs.counter("serving.replica.scale_ups").value
+            assert pool.set_active_replicas(4) == 4    # reactivate
+            assert obs.counter("serving.replica.scale_ups").value == s0 + 1
+            # reactivated replica serves again
+            deadline = time.time() + 20
+            while time.time() < deadline and parked.dispatches == before:
+                for _ in range(8):
+                    pool.predict({"x": X}, timeout=30)
+            assert parked.dispatches > before
+
+    def test_autoscale_tick_up_immediate_down_hysteresis(self, model_dir):
+        with _pool(model_dir, replicas=4, initial_replicas=1,
+                   scale_down_after_s=5.0) as pool:
+            t0 = 100.0
+            # scale-UP applies immediately
+            assert pool.autoscale_tick(3, now=t0) == 3
+            # scale-DOWN waits out the hysteresis window
+            assert pool.autoscale_tick(1, now=t0 + 1) == 3
+            assert pool.autoscale_tick(1, now=t0 + 4) == 3
+            assert pool.autoscale_tick(1, now=t0 + 6.1) == 1
+
+    def test_autoscale_no_thrash_on_recovered_window(self, model_dir):
+        with _pool(model_dir, replicas=4, initial_replicas=3,
+                   scale_down_after_s=5.0) as pool:
+            t0 = 100.0
+            assert pool.autoscale_tick(1, now=t0) == 3
+            # desired recovers inside the window: the dip must not stick
+            assert pool.autoscale_tick(3, now=t0 + 2) == 3
+            assert pool.autoscale_tick(1, now=t0 + 3) == 3
+            # a FRESH window starts at t0+3; its expiry is t0+8
+            assert pool.autoscale_tick(1, now=t0 + 6) == 3
+            assert pool.autoscale_tick(1, now=t0 + 8.1) == 1
+
+    def test_autoscale_down_lands_on_window_peak(self, model_dir):
+        with _pool(model_dir, replicas=4, initial_replicas=4,
+                   scale_down_after_s=5.0) as pool:
+            t0 = 50.0
+            assert pool.autoscale_tick(1, now=t0) == 4
+            assert pool.autoscale_tick(3, now=t0 + 2) == 4   # still below 4
+            # window expires: land on the HIGHEST desired seen inside it
+            assert pool.autoscale_tick(2, now=t0 + 5.5) == 3
+
+    def test_autoscale_tick_consumes_gauge(self, model_dir):
+        with _pool(model_dir, replicas=4, initial_replicas=1) as pool:
+            obs.gauge("serving.autoscale.desired_replicas").set(2)
+            assert pool.autoscale_tick() == 2
+            assert pool.active_replicas() == 2
+
+    def test_slo_monitor_drives_activate_and_quiesce(self, model_dir):
+        """Satellite: SLOMonitor.desired_replicas -> pool
+        activate/quiesce under a synthetic overload window, then the
+        clean-window scale-down back to min_replicas."""
+        backlog = {"interactive": 0}
+        mon = obs.SLOMonitor([], backlog_fn=lambda: dict(backlog),
+                             service_rate_fn=lambda: 25.0,
+                             max_replicas=4)
+        with _pool(model_dir, replicas=4, initial_replicas=1,
+                   min_replicas=1, scale_down_after_s=4.0) as pool:
+            t0 = 10.0
+            # overload window: 100 rows ahead at 25 rows/s -> 4 replicas
+            backlog["interactive"] = 100
+            assert pool.autoscale_tick(mon.desired_replicas(), now=t0) == 4
+            assert pool.active_replicas() == 4
+            # clean windows: desired falls to min, applied only after
+            # the hysteresis (no single clean window may quiesce)
+            backlog["interactive"] = 0
+            assert pool.autoscale_tick(mon.desired_replicas(),
+                                       now=t0 + 1) == 4
+            assert pool.autoscale_tick(mon.desired_replicas(),
+                                       now=t0 + 5.5) == 1
+            assert pool.active_replicas() == pool.min_replicas
+            states = [r.state() for r in pool._replicas]
+            assert states.count("parked") == 3
+
+    def test_queue_parallelism_scales_admission_estimate(self):
+        q = serving.RequestQueue(64)
+        q.note_service(rows=100, seconds=1.0)    # 100 rows/s per consumer
+        for _ in range(20):
+            q.put(serving.Request(feed=None, rows=1))
+        w1 = q.estimated_wait_s()
+        q.set_parallelism(2)
+        w2 = q.estimated_wait_s()
+        assert abs(w1 - 0.2) < 1e-6
+        assert abs(w2 - 0.1) < 1e-6
+
+
+# -- rolling swap ------------------------------------------------------------
+
+class TestRollingSwap:
+    def test_swap_flips_every_replica(self, model_dir, tmp_path):
+        d2 = _save_model(str(tmp_path / "v2"), seed=9)
+        rng = np.random.RandomState(1)
+        X = rng.rand(1, WIDTH).astype(np.float32)
+        ref = serving.InferenceEngine(d2, batch_buckets=(2, 4),
+                                      supervise=False)
+        want = ref.predict({"x": X})[0]
+        ref.stop()
+        s0 = obs.counter("serving.replica.swapped").value
+        with _pool(model_dir, replicas=2) as pool:
+            assert pool.swap_model(d2) == 2
+            assert pool.model_version == 2
+            assert pool.health()["model_versions"] == [2]
+            assert obs.counter("serving.replica.swapped").value == s0 + 2
+            got = pool.predict({"x": X}, timeout=30)[0]
+            assert got.tobytes() == want.tobytes()
+
+    def test_swap_feed_mismatch_rejected(self, model_dir, tmp_path):
+        bad = _save_model(str(tmp_path / "bad"), seed=9, width=WIDTH + 2)
+        X = np.zeros((1, WIDTH), np.float32)
+        with _pool(model_dir, replicas=2) as pool:
+            with pytest.raises(serving.ServingError):
+                pool.swap_model(bad)
+            # the rejected swap left the pool serving v1, all replicas
+            assert pool.state == "ready"
+            assert pool.health()["model_versions"] == [1]
+            assert pool.predict({"x": X}, timeout=30)[0].shape == (1, 4)
+
+
+# -- review-hardening regressions -------------------------------------------
+
+class TestImpairedRotation:
+    def test_scale_down_parks_failed_first(self, model_dir):
+        """Quiescing must never park the last healthy replica while a
+        dead-past-budget one squats in the rotation."""
+        with _pool(model_dir, replicas=2, supervise=False) as pool:
+            pool._replicas[0].failed = True
+            assert pool.set_active_replicas(1) == 1
+            assert not pool._replicas[0].active     # failed parked first
+            assert pool._replicas[1].active
+            assert pool.ready_replicas() == 1
+
+    def test_scale_up_backfills_failed_active(self, model_dir):
+        """A failed replica in the rotation must not count toward the
+        target: scaling to N activates a parked healthy spare."""
+        with _pool(model_dir, replicas=3, initial_replicas=2,
+                   supervise=False) as pool:
+            pool._replicas[0].failed = True
+            assert pool.set_active_replicas(2) >= 2
+            assert pool._replicas[2].active         # spare backfilled
+            healthy = [r for r in pool._replicas
+                       if r.active and not r.failed]
+            assert len(healthy) == 2
+
+    def test_parallelism_tracks_breaker_and_rotation_live(self, model_dir):
+        # the queue's consumer count is a LIVE callable: breaker ejects
+        # and rotation resizes reflect at the next admission estimate
+        # with no bookkeeping at each flip
+        with _pool(model_dir, replicas=4, breaker_cooldown_s=60.0) as pool:
+            par = pool._queue._parallelism
+            assert callable(par) and par() == 4
+            rep = pool._replicas[0]
+            for _ in range(pool._breaker_threshold):
+                rep.breaker.record_fatal()
+            assert par() == 3                    # ejected replica dropped
+            pool.set_active_replicas(2)
+            assert par() == 2                    # quiesced replicas too
+        # and the queue divides its wait estimate by the callable's value
+        q = serving.RequestQueue(64)
+        q.set_parallelism(lambda: 4)
+        q.note_service(rows=100, seconds=1.0)
+        for _ in range(20):
+            q.put(serving.Request(feed=None, rows=1))
+        assert abs(q.estimated_wait_s() - 20 / (100.0 * 4)) < 1e-6
+        q.drain_remaining(lambda r: serving.ServingClosed("test"))
+
+    def test_swap_covers_sole_ready_replica(self, model_dir, tmp_path):
+        """Rolling swap of a PARTIAL rotation: a parked warm sibling is
+        opened as cover while the sole ready replica drains, so ready
+        capacity never touches 0; the cover is re-parked after."""
+        from paddle_tpu.testing import faults
+
+        d2 = _save_model(str(tmp_path / "v2"), seed=9)
+        X = np.zeros((1, WIDTH), np.float32)
+        with _pool(model_dir, replicas=2, initial_replicas=1,
+                   queue_capacity=512) as pool:
+            stop = threading.Event()
+            min_ready = [pool.ready_replicas()]
+            futs = []
+
+            def sampler():
+                while not stop.is_set():
+                    min_ready[0] = min(min_ready[0],
+                                       pool.ready_replicas())
+                    time.sleep(0.001)
+
+            def submitter():
+                while not stop.is_set():
+                    try:
+                        futs.append(pool.predict_async({"x": X}))
+                    except serving.ServingQueueFull:
+                        pass
+                    time.sleep(0.002)
+
+            ths = [threading.Thread(target=sampler),
+                   threading.Thread(target=submitter)]
+            for t in ths:
+                t.start()
+            try:
+                # slow dispatches keep work in flight, so the drain
+                # window is wide enough that losing cover would be seen
+                with faults.slow_execute(0.03):
+                    time.sleep(0.1)
+                    assert pool.swap_model(d2) == 2
+            finally:
+                stop.set()
+                for t in ths:
+                    t.join()
+            for f in futs:
+                f.result(timeout=60)
+            assert min_ready[0] >= 1, (
+                "ready replicas hit %d during a partial-rotation swap"
+                % min_ready[0])
+            assert pool.active_replicas() == 1   # cover re-parked
+            assert pool.health()["model_versions"] == [2]
