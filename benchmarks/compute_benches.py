@@ -1,0 +1,93 @@
+"""Deterministic compute-bench scenarios shared by the perf tooling.
+
+One module owns the programs/feeds that tools/check_perf_drift.py turns
+into committed baseline invariants and tools/perf_report.py turns into
+roofline reports — so the gate and the report can never drift apart on
+what "the MLP train bench" means.  Everything here is seeded and
+shape-fixed: the scenarios exist to produce *deterministic* numbers
+(compile counts, host-copy counts, XLA flops/bytes, padded rows), never
+wall-clock.
+
+CPU-friendly by design (the drift gate runs in tier-1 on the hermetic
+8-device CPU mesh); the same builders run unchanged on a real TPU for
+perf_report numbers worth publishing.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_mlp_train(batch=16, width=32, hidden=64, classes=4, seed=7,
+                    lr=0.1):
+    """Seeded MLP classifier + SGD training step.  Returns
+    ``(main, startup, loss, feed)`` with a fixed-shape feed dict."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        p = fluid.layers.fc(input=h, size=classes, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    rng = np.random.RandomState(seed)
+    feed = {
+        "x": rng.randn(batch, width).astype(np.float32),
+        "y": rng.randint(0, classes, size=(batch, 1)).astype(np.int64),
+    }
+    return main, startup, loss, feed
+
+
+def build_mlp_eval(batch=16, width=32, hidden=64, classes=4, seed=7):
+    """Seeded MLP inference program (no optimizer, no state writes).
+    Returns ``(main, startup, out, feed)``."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        out = fluid.layers.fc(input=h, size=classes, act="softmax")
+    rng = np.random.RandomState(seed)
+    feed = {"x": rng.randn(batch, width).astype(np.float32)}
+    return main, startup, out, feed
+
+
+def save_serving_model(dirname, width=8, classes=4, seed=5):
+    """Save a tiny inference model for the serving scenarios (the same
+    shape the serving unit tests use)."""
+    import paddle_tpu as fluid
+
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        out = fluid.layers.fc(x, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [out], exe,
+                                      main_program=main)
+    return dirname
+
+
+def serving_payloads(n, width=8, seed=11):
+    """``n`` seeded single-row payloads for the padded-bucket scenario —
+    submitted one at a time so the bucket/padding accounting is
+    batching-order independent, hence deterministic."""
+    rng = np.random.RandomState(seed)
+    return [rng.randn(1, width).astype(np.float32) for _ in range(n)]

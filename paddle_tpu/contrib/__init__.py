@@ -1,5 +1,5 @@
 """Contrib utilities (reference: python/paddle/fluid/contrib/)."""
-from .memory_usage_calc import memory_usage  # noqa: F401
+from .memory_usage_calc import memory_analysis, memory_usage  # noqa: F401
 from . import quantize  # noqa: F401
 from . import mixed_precision  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401
@@ -9,6 +9,7 @@ from .quantize import QuantizeTranspiler  # noqa: F401
 
 __all__ = [
     "memory_usage",
+    "memory_analysis",
     "quantize",
     "mixed_precision",
     "op_freq_statistic",

@@ -48,6 +48,7 @@ import numpy as np
 from . import core
 from . import observability as _obs
 from . import profiler as _prof
+from .observability import xla_stats as _xla_stats
 from . import resilience
 from .framework import (
     GRAD_SUFFIX,
@@ -1229,6 +1230,7 @@ class Executor:
             call_entry = lambda *a: _retry_fresh_entry(entry, *a)  # noqa: E731
 
         execute_s = None
+        xs_active = _xla_stats.active()
         if _prof.is_profiling():
             import jax
 
@@ -1237,17 +1239,35 @@ class Executor:
             jax.block_until_ready(fetches)
             execute_s = time.perf_counter() - t0
             _prof.record("executor.run[prog@%x v%d]" % (id(program), program.version), execute_s)
-        elif recording or self._telemetry.span_active():
+        elif recording or self._telemetry.span_active() or xs_active:
             # span-only sinks (a trace with no record sink) must still
-            # see the dispatch/compile spans, not just the other sites'
+            # see the dispatch/compile spans, not just the other sites';
+            # an armed compute-introspection plane needs the step time for
+            # the MFU / BW-util gauges even with no sink attached
             t0 = time.perf_counter()
             with self._telemetry.span(
                     "executor.compile" if compiled_fresh
                     else "executor.dispatch"):
                 fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
+            if xs_active and _xla_stats.sync_timing():
+                import jax
+
+                jax.block_until_ready(fetches)
             execute_s = time.perf_counter() - t0
         else:
             fetches, new_state, new_key = call_entry(state_in, feed_arrays, key)
+        if xs_active and execute_s is not None:
+            # a step whose wall includes an XLA compile — a fresh entry,
+            # or the step that paid the capture's AOT compile (plane
+            # armed mid-run) — must not land in MFU.  The entry's own
+            # capture cell (not the program tag) supplies the stats, so
+            # shape-distinct entries of one program never cross wires.
+            cap = getattr(entry, "_xla_cap", None)
+            if cap is not None:
+                if cap["fresh"] or compiled_fresh:
+                    cap["fresh"] = False
+                elif cap["stats"] is not None:
+                    _xla_stats.observe_stats(cap["stats"], execute_s)
         if nan_guard and getattr(entry, "_guard_cell", {}).get("emits"):
             # the guard verdict rides as an extra trailing pseudo-fetch;
             # peel it off before anything sees the fetch list (guard off,
@@ -1497,14 +1517,29 @@ class Executor:
         if resilience._feed_fault is not None:  # fault-injection harness
             feed_arrays = resilience._feed_fault(feed_arrays)
         self._last_guard_flag = None  # never report a previous run's verdict
-        if recording or self._telemetry.span_active():
+        execute_s = None
+        xs_active = _xla_stats.active()
+        if recording or self._telemetry.span_active() or xs_active:
             t0 = time.perf_counter()
             with self._telemetry.span("executor.dispatch"):
                 fetches, new_state, new_key = bound.entry(
                     state_in, feed_arrays, key)
+            if xs_active and _xla_stats.sync_timing():
+                import jax
+
+                jax.block_until_ready(fetches)
             execute_s = time.perf_counter() - t0
         else:
             fetches, new_state, new_key = bound.entry(state_in, feed_arrays, key)
+        if xs_active and execute_s is not None:
+            cap = getattr(bound.entry, "_xla_cap", None)
+            if cap is not None:
+                if cap["fresh"]:
+                    # this step paid the capture's AOT compile (plane
+                    # armed mid-run): its wall is not a step time
+                    cap["fresh"] = False
+                elif cap["stats"] is not None:
+                    _xla_stats.observe_stats(cap["stats"], execute_s)
         if bound.guard:
             self._last_guard_flag = fetches[-1][0]
             fetches = fetches[:-1]
@@ -1652,6 +1687,16 @@ class Executor:
     def _build(self, program, feed_names, fetch_names, state_names,
                nan_guard=False):
         import jax
+
+        # compute-introspection capture: one analysis per built ENTRY
+        # (shape-distinct entries of one program each get their own cell,
+        # so MFU never divides one entry's time by another's flops),
+        # registered under the same program tag step records carry;
+        # armed/disarmed per call so enabling the plane mid-run captures
+        # on the next step.  "fresh" marks the step that PAID the capture
+        # compile — run()/_run_bound skip observing that step's time.
+        prog_tag = "%x:v%d" % (id(program), getattr(program, "version", 0))
+        cap_cell = {"done": False, "stats": None, "fresh": False}
 
         persistable_names = program.persistable_names()
         # a fetch that aliases a state output (fetching a param directly, or
@@ -1810,6 +1855,15 @@ class Executor:
                         conformed[n] = jax.device_put(v, device)
                 if conformed is not None:
                     feeds = conformed
+                if _xla_stats.active() and not cap_cell["done"]:
+                    # capture BEFORE the first real call so the gauges are
+                    # live by the time the step's record/observe fires;
+                    # lower+compile is pure (no state/RNG effects), so the
+                    # step itself is bitwise-unaffected
+                    cap_cell["done"] = True
+                    cap_cell["fresh"] = True
+                    cap_cell["stats"] = _xla_stats.capture_jitted(
+                        prog_tag, jitted, (mut, ro, feeds, key))
                 if is_default_device:
                     return jitted(mut, ro, feeds, key)
                 with jax.default_device(device):
@@ -1817,6 +1871,7 @@ class Executor:
 
             runner._alias_cell = alias_cell
             runner._guard_cell = guard_cell
+            runner._xla_cap = cap_cell
             return runner
 
         def step(state, feeds, key):
@@ -1971,6 +2026,12 @@ class Executor:
                     conformed[n] = jax.device_put(v, want_sh)
             if conformed is not None:
                 feeds = conformed
+            if _xla_stats.active() and not cap_cell["done"]:
+                cap_cell["done"] = True
+                cap_cell["fresh"] = True
+                cap_cell["stats"] = _xla_stats.capture_jitted(
+                    prog_tag, cell["jit"], (state, feeds, key),
+                    num_devices=int(np.prod(mesh.devices.shape)))
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
                 try:
@@ -2004,6 +2065,7 @@ class Executor:
 
         runner._alias_cell = alias_cell
         runner._guard_cell = guard_cell
+        runner._xla_cap = cap_cell
         return runner
 
     def close(self):

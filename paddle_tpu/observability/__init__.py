@@ -27,6 +27,14 @@ module-level counters:
   async feed pipeline buys is visually verifiable.
 - pluggable sinks (:mod:`~.sinks`) — JSONL file, in-memory ring buffer
   for tests, periodic stdout summary, Chrome-trace exporter.
+- compute introspection (:mod:`~.xla_stats`, :mod:`~.attribution`) —
+  per-compiled-program XLA cost/memory capture published as
+  ``compute.*`` gauges (flops, bytes accessed, peak HBM, MFU and
+  HBM-BW utilization against a per-device peak table), and
+  :class:`StepAttribution`, a sink that decomposes step wall into
+  input/compute/compile/fetch phases and classifies each window
+  input-bound vs compute-bound.  See docs/observability.md "Compute
+  introspection & MFU".
 
 ``PADDLE_TPU_TELEMETRY=0`` is the process-wide killswitch: step records,
 spans, and the profiler's implicit stdout report all go quiet; counter
@@ -48,7 +56,14 @@ Usage::
 """
 from __future__ import annotations
 
-from .export import MetricsServer, prometheus_name, render_prometheus
+from . import xla_stats
+from .attribution import PHASE_OF_SPAN, StepAttribution
+from .export import (
+    MetricsServer,
+    parse_prometheus,
+    prometheus_name,
+    render_prometheus,
+)
 from .histogram import Histogram, HistogramSnapshot, default_bounds
 from .registry import (
     Counter,
@@ -120,9 +135,13 @@ __all__ = [
     "MetricsServer",
     "render_prometheus",
     "prometheus_name",
+    "parse_prometheus",
     "SLOMonitor",
     "SLOTarget",
     "SLOAlert",
+    "xla_stats",
+    "StepAttribution",
+    "PHASE_OF_SPAN",
 ]
 
 # The step-record schema every future perf/robustness PR reports into.
@@ -153,5 +172,6 @@ STEP_SCHEMA = {
         "checkpoint_save_s",  # duration, present on checkpoint steps
         "checkpoint_load_s",  # duration, present after a rewind/resume
         "metrics",         # fetched scalar metrics when cheaply available
+        "mfu",             # model flops utilization when xla_stats is armed
     ],
 }

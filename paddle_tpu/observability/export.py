@@ -27,13 +27,86 @@ can't wedge the engine.
 from __future__ import annotations
 
 import json
+import re
 import threading
 
 from .registry import get_telemetry
 
-__all__ = ["render_prometheus", "MetricsServer", "prometheus_name"]
+__all__ = ["render_prometheus", "MetricsServer", "prometheus_name",
+           "parse_prometheus"]
 
 _EXPO_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# one Prometheus text-exposition sample line: name{labels} value [timestamp]
+# (the optional trailing millisecond timestamp appears on /federate output
+# and many exporters — the scrape-driven autoscaler must parse those too)
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?) '
+    r'(NaN|[+-]?Inf|[+-]?[0-9][0-9eE.+-]*)'
+    r'( [+-]?[0-9]+)?$')
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(counter|gauge|summary|histogram|untyped)$")
+
+
+def parse_prometheus(text, strict=True):
+    """Minimal exposition parser (the inverse of
+    :func:`render_prometheus`): returns ``{sample_name: value}`` where
+    ``sample_name`` includes any ``{labels}`` suffix verbatim; an
+    optional trailing sample timestamp (``/federate`` output) is
+    accepted and dropped.
+
+    ``strict=True`` (the gate mode, for expositions WE rendered) raises
+    ``ValueError`` on a malformed line, a duplicate sample, or two TYPE
+    declarations for one family — the regressions a compliant Prometheus
+    scraper would reject the whole exposition over.  ``strict=False``
+    (the scrape mode — the autoscaler pointed at a third-party exporter
+    or federation proxy) extracts every line this simple grammar CAN
+    read and skips the rest (escaped-quote label values, exotic
+    comments, tab separators), because one unreadable foreign line must
+    not blind the consumer to the sample it came for; on a duplicate,
+    the first wins."""
+    samples = {}
+    typed = set()
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if not (m or line.startswith("# HELP")):
+                if strict:
+                    raise ValueError(
+                        "malformed comment line %d: %r" % (ln, line))
+                continue
+            if m:
+                fam = line.split()[2]
+                # two TYPE declarations for one family (e.g. a timer AND
+                # a histogram sharing a registry name) make a compliant
+                # scraper reject the whole exposition
+                if fam in typed:
+                    if strict:
+                        raise ValueError(
+                            "duplicate metric family %r (line %d)"
+                            % (fam, ln))
+                    continue
+                typed.add(fam)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            if strict:
+                raise ValueError(
+                    "malformed sample line %d: %r" % (ln, line))
+            continue
+        name_part, value = m.group(1), m.group(2)
+        v = float(value.replace("Inf", "inf"))
+        if name_part in samples:
+            if strict:
+                raise ValueError(
+                    "duplicate sample %r (line %d)" % (name_part, ln))
+            continue
+        samples[name_part] = v
+    return samples
 
 
 def prometheus_name(name, prefix="paddle_tpu_"):

@@ -26,7 +26,7 @@ from collections import defaultdict
 
 from . import observability as _obs
 
-__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event", "is_profiling", "record", "profile_program", "compiled_op_report"]
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler", "stop_profiler", "record_event", "is_profiling", "record", "profile_program", "compiled_op_report", "compile_step"]
 
 # every host-side profiler timing is a registry timer under this prefix;
 # the report and reset touch only this namespace
@@ -195,7 +195,22 @@ def _parse_hlo_op_rows(hlo_text, known_op_types):
     return dict(rows)
 
 
-def compiled_op_report(program, feed, state=None, fetch_list=None, sorted_key="instructions"):
+def compile_step(program, feed, state=None, fetch_list=None):
+    """Lower + compile the whole-block step ONCE, outside the executor's
+    caches, and hand back the ``jax.stages.Compiled``.  The introspection
+    primitive shared by :func:`compiled_op_report` (optimized-HLO text),
+    tools/perf_report.py (cost/memory analysis via
+    ``observability.xla_stats.extract_compiled``) and
+    ``contrib.memory_usage`` — one compile serves all three views."""
+    import jax
+
+    from .jax_bridge import program_to_fn
+
+    fn = program_to_fn(program, fetch_list or [], return_state=True)
+    return jax.jit(fn).lower(dict(state or {}), dict(feed)).compile()
+
+
+def compiled_op_report(program, feed, state=None, fetch_list=None, sorted_key="instructions", compiled=None):
     """Per-op attribution of the REAL compiled step (reference:
     paddle/fluid/platform/profiler.cc's per-op device table).
 
@@ -207,17 +222,13 @@ def compiled_op_report(program, feed, state=None, fetch_list=None, sorted_key="i
     Program ops: instruction count and output bytes per op, ``<op>_grad``
     rows for backward instructions.  Complements ``profile_program`` (an
     eager per-op cost model) with ground truth about the fused step.
+    Pass an already-built ``compiled`` (from :func:`compile_step`) to
+    reuse one compile across reports.
 
     Returns (report_str, rows_dict).
     """
-    import jax
-
-    from .jax_bridge import program_to_fn
-
-    fetch_names = fetch_list or []
-    fn = program_to_fn(program, fetch_names, return_state=True)
-    state = dict(state or {})
-    compiled = jax.jit(fn).lower(state, dict(feed)).compile()
+    if compiled is None:
+        compiled = compile_step(program, feed, state, fetch_list)
     hlo = compiled.as_text()
     known = {op.type for op in program.global_block().ops}
     rows = _parse_hlo_op_rows(hlo, known)

@@ -40,6 +40,10 @@ stay global:
   only after ``scale_down_after_s`` of consistently lower desire
   (no-thrash hysteresis).  Quiesce = stop claiming, let in-flight
   finish, park warm; reactivation is one flag flip away.
+  :meth:`start_autoscaler` loops it from an in-process ``SLOMonitor``,
+  the latest published gauge, or — ``metrics_url=`` — a live
+  Prometheus-text ``/metrics`` scrape, so sizing can follow a monitor
+  running in a different process entirely.
 
 Bitwise contract: rows are computed independently of batch neighbors,
 padding, and position (the engine's bucket-ladder contract), and every
@@ -850,17 +854,55 @@ class ReplicaPool:
         self._below_since = None
         return active
 
-    def start_autoscaler(self, monitor=None, interval_s=None):
+    def start_autoscaler(self, monitor=None, interval_s=None,
+                         metrics_url=None, metric=None, prefix="paddle_tpu_",
+                         scrape_timeout_s=2.0):
         """Run the autoscale loop on a daemon thread: each tick either
         evaluates ``monitor`` (an
         :class:`~paddle_tpu.observability.SLOMonitor`, typically
-        constructed with ``engine=pool``) and applies its
-        ``desired_replicas``, or — without a monitor — consumes the
-        latest published gauge value."""
+        constructed with ``engine=pool``), scrapes ``metrics_url``, or —
+        with neither — consumes the latest published gauge value.
+
+        ``metrics_url`` drives sizing from a LIVE Prometheus-text scrape
+        (any ``/metrics`` endpoint — this pool's own
+        :meth:`serve_metrics`, another process's exporter, or a
+        Prometheus federation proxy), decoupling the autoscaler from an
+        in-process :class:`SLOMonitor`: the monitor can run wherever the
+        metrics live.  Each tick fetches the exposition, parses it with
+        :func:`~paddle_tpu.observability.parse_prometheus` in lenient
+        mode (a third-party exporter's exotic lines are skipped, not
+        fatal), and applies the ``serving.autoscale.desired_replicas``
+        sample (spelled ``<prefix>serving_autoscale_desired_replicas``;
+        override the exact sample name with ``metric``).  A failed
+        scrape (or raising monitor) skips that tick — sizing must
+        outlive a flaky exporter — counting on
+        ``serving.autoscale.tick_errors``, and an absent sample counts
+        on ``serving.autoscale.scrape_misses``, so an inert wiring (bad
+        URL, mistyped metric name) is visible to the operator instead
+        of silently idling."""
+        if monitor is not None and metrics_url is not None:
+            raise ValueError("pass monitor= or metrics_url=, not both")
         if self._autoscaler is not None and self._autoscaler.is_alive():
             return self
         period = float(interval_s) if interval_s is not None else (
             monitor.window_s if monitor is not None else 1.0)
+        scrape_name = None
+        if metrics_url is not None:
+            from ..observability.export import parse_prometheus, \
+                prometheus_name
+
+            scrape_name = metric or prometheus_name(
+                "serving.autoscale.desired_replicas", prefix)
+
+            def scrape_desired():
+                import urllib.request
+
+                with urllib.request.urlopen(
+                        metrics_url, timeout=scrape_timeout_s) as resp:
+                    body = resp.read().decode("utf-8", "replace")
+                v = parse_prometheus(body, strict=False).get(scrape_name)
+                return None if v is None else int(round(v))
+
         self._autoscaler_stop.clear()
 
         def loop():
@@ -869,9 +911,19 @@ class ReplicaPool:
                     desired = None
                     if monitor is not None:
                         desired = monitor.evaluate()["desired_replicas"]
+                    elif scrape_name is not None:
+                        desired = scrape_desired()
+                        if desired is None:
+                            # sample absent: not a decision — but leave
+                            # a trail, or a mistyped metric name would
+                            # look exactly like a healthy idle loop
+                            _obs.inc("serving.autoscale.scrape_misses")
+                            continue
                     self.autoscale_tick(desired)
                 except Exception:
-                    pass   # scaling must outlive a flaky health probe
+                    # scaling must outlive a flaky health probe /
+                    # exporter; the counter keeps it from failing silent
+                    _obs.inc("serving.autoscale.tick_errors")
 
         self._autoscaler = threading.Thread(
             target=loop, name="paddle-tpu-replica-autoscaler", daemon=True)

@@ -220,6 +220,42 @@ def test_render_prometheus_all_cell_kinds():
     assert "paddle_tpu_h_seconds_count 1.0" in text
 
 
+def test_parse_prometheus_roundtrip_and_strictness():
+    tel = obs.Telemetry(enabled=True)
+    tel.counter("c").inc(3)
+    tel.gauge("g").set(1.5)
+    tel.histogram("h").observe(0.25)
+    samples = obs.parse_prometheus(obs.render_prometheus(tel))
+    assert samples["paddle_tpu_c_total"] == 3.0
+    assert samples["paddle_tpu_g"] == 1.5
+    assert samples['paddle_tpu_h_seconds_bucket{le="+Inf"}'] == 1.0
+    with pytest.raises(ValueError):
+        obs.parse_prometheus("not a metric line !!!")
+    with pytest.raises(ValueError):
+        obs.parse_prometheus("# TYPE x gauge\nx 1\n# TYPE x gauge\n")
+    with pytest.raises(ValueError):
+        obs.parse_prometheus("x 1\nx 2\n")
+    # trailing sample timestamps (/federate output) parse as the VALUE,
+    # not as "name value" -> timestamp — the scrape-driven autoscaler
+    # reads federation endpoints too
+    fed = obs.parse_prometheus(
+        'paddle_tpu_serving_autoscale_desired_replicas 3 1712345678901\n'
+        'with_labels{a="b"} 1.5 1712345678901\n')
+    assert fed["paddle_tpu_serving_autoscale_desired_replicas"] == 3.0
+    assert fed['with_labels{a="b"}'] == 1.5
+    # lenient mode (the autoscaler scraping a THIRD-PARTY exporter):
+    # lines this simple grammar can't read are skipped, never fatal
+    foreign = ('# arbitrary comment\n'
+               'weird{path="C:\\\\x"} 1\n'
+               "dup 1\ndup 2\n"
+               'paddle_tpu_serving_autoscale_desired_replicas 4\n')
+    lenient = obs.parse_prometheus(foreign, strict=False)
+    assert lenient["paddle_tpu_serving_autoscale_desired_replicas"] == 4.0
+    assert lenient["dup"] == 1.0  # first wins
+    with pytest.raises(ValueError):
+        obs.parse_prometheus(foreign)  # strict mode still rejects it
+
+
 def test_metrics_server_serves_scrape_and_404():
     tel = obs.Telemetry(enabled=True)
     tel.counter("hits").inc(7)

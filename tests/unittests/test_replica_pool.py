@@ -271,6 +271,33 @@ class TestScaling:
             assert pool.autoscale_tick() == 2
             assert pool.active_replicas() == 2
 
+    def test_scrape_driven_autoscaler(self, model_dir):
+        """Satellite: start_autoscaler(metrics_url=...) sizes the
+        rotation from a LIVE Prometheus-text scrape of /metrics — the
+        monitor can live in another process; the pool only needs the
+        exposition.  Scale-up is immediate, so the loop converges within
+        a few 50ms ticks."""
+        obs.gauge("serving.autoscale.desired_replicas").set(1)
+        try:
+            with _pool(model_dir, replicas=3, initial_replicas=1,
+                       scale_down_after_s=60.0) as pool:
+                with pytest.raises(ValueError):
+                    pool.start_autoscaler(monitor=object(),
+                                          metrics_url="http://x/metrics")
+                srv = pool.serve_metrics()
+                pool.start_autoscaler(metrics_url=srv.url + "/metrics",
+                                      interval_s=0.05)
+                assert pool.active_replicas() == 1
+                obs.gauge("serving.autoscale.desired_replicas").set(3)
+                deadline = time.time() + 10
+                while (time.time() < deadline
+                       and pool.active_replicas() != 3):
+                    time.sleep(0.02)
+                assert pool.active_replicas() == 3
+                pool.stop_autoscaler()
+        finally:
+            obs.gauge("serving.autoscale.desired_replicas")._reset()
+
     def test_slo_monitor_drives_activate_and_quiesce(self, model_dir):
         """Satellite: SLOMonitor.desired_replicas -> pool
         activate/quiesce under a synthetic overload window, then the

@@ -49,7 +49,6 @@ Exit code 0 = every scenario held.
 """
 import json
 import os
-import re
 import sys
 import tempfile
 import time
@@ -66,16 +65,6 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never touch a TPU from CI
 import numpy as np  # noqa: E402
 
 BUCKETS = (2, 4, 8)
-
-# one Prometheus text-exposition sample line: name{labels} value
-_SAMPLE_RE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
-    r'(NaN|[+-]?Inf|[+-]?[0-9].*)$')
-_TYPE_RE = re.compile(
-    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-    r"(counter|gauge|summary|histogram|untyped)$")
-
 
 def save_model(dirname, seed):
     import paddle_tpu as fluid
@@ -152,35 +141,16 @@ def scenario_histogram_accuracy():
 
 
 def _parse_prometheus(text):
-    """Minimal exposition parser: returns {metric_name: value} for plain
-    samples and {(name, labels): value} for labeled ones; raises on any
-    malformed line."""
-    samples = {}
-    typed = set()
-    for ln, line in enumerate(text.splitlines(), 1):
-        if not line:
-            continue
-        if line.startswith("#"):
-            m = _TYPE_RE.match(line)
-            assert m or line.startswith("# HELP"), (
-                "malformed comment line %d: %r" % (ln, line))
-            if m:
-                fam = line.split()[2]
-                # two TYPE declarations for one family (e.g. a timer AND
-                # a histogram sharing a registry name) make a compliant
-                # scraper reject the whole exposition
-                assert fam not in typed, (
-                    "duplicate metric family %r (line %d)" % (fam, ln))
-                typed.add(fam)
-            continue
-        assert _SAMPLE_RE.match(line), (
-            "malformed sample line %d: %r" % (ln, line))
-        name_part, value = line.rsplit(" ", 1)
-        v = float(value.replace("Inf", "inf"))
-        assert name_part not in samples, (
-            "duplicate sample %r (line %d)" % (name_part, ln))
-        samples[name_part] = v
-    return samples
+    """Strict exposition parse via the shared library parser (it moved to
+    observability.export so the scrape-driven autoscaler uses the same
+    code); re-raised as AssertionError so a malformed exposition is
+    reported as a scenario failure like every other gate assert."""
+    from paddle_tpu.observability import parse_prometheus
+
+    try:
+        return parse_prometheus(text)
+    except ValueError as e:
+        raise AssertionError(str(e))
 
 
 def scenario_metrics_export():
