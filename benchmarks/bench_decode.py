@@ -28,9 +28,34 @@ sequences' tokens ride one fixed-shape dispatch — the same lever on a
 TPU, where the per-dispatch cost is even more expensive relative to
 per-row compute (chip capture queued via tools/tpu_watchdog2.sh).
 
+Two further legs ride the same harness (ISSUE 15):
+
+  --long-prompts   : a mixed long/short open-loop load through the SAME
+                     continuous-batching config twice — monolithic
+                     prefill vs chunked prefill
+                     (``prefill_chunk_tokens``).  Monolithic prefill
+                     head-of-line-blocks every active decode slot and
+                     every queued short prompt for a long prompt's whole
+                     prefill; chunking bounds the per-iteration prefill
+                     work by the chunk budget.  A one-token-per-request
+                     TTFT probe: reported per leg are p95 TTFT (overall
+                     and over the SHORT prompts stuck behind the burst
+                     — the interactive number chunking exists for) and
+                     tokens/s; smoke asserts >= 3x better short-prompt
+                     p95 TTFT at no tokens/s regression, plus bitwise
+                     token equality between the legs.
+  --repeated-prefix: a shared-prefix fan-out (one system prompt, many
+                     tails) served with the prefix cache off vs on.
+                     Reported: page hit rate and prefill-token
+                     reduction; smoke asserts >= 50% fewer prompt
+                     tokens prefilled and bitwise-identical outputs
+                     warm vs cold.
+
 Usage:
   python benchmarks/bench_decode.py            # full run, prints JSON
   python benchmarks/bench_decode.py --smoke    # quick run + assertions
+  python benchmarks/bench_decode.py --long-prompts [--smoke]
+  python benchmarks/bench_decode.py --repeated-prefix [--smoke]
 """
 from __future__ import annotations
 
@@ -72,14 +97,14 @@ def _pct(xs, q):
 
 
 def run_leg(model, prompts, arrivals, max_new, max_active, num_slots,
-            page_size, max_seq_len):
+            page_size, max_seq_len, **cfg_kw):
     from paddle_tpu import serving
     from paddle_tpu.executor import compile_count
 
     sched = serving.DecodeScheduler(model, serving.DecodeConfig(
         num_slots=num_slots, max_active=max_active, page_size=page_size,
         max_seq_len=max_seq_len, max_new_tokens=max_new,
-        queue_capacity=max(256, 2 * len(prompts))))
+        queue_capacity=max(256, 2 * len(prompts)), **cfg_kw))
     c0 = compile_count()
     t0 = time.perf_counter()
     futs = []
@@ -104,23 +129,206 @@ def run_leg(model, prompts, arrivals, max_new, max_active, num_slots,
         "generated_tokens": n_tokens,
         "elapsed_s": round(elapsed, 4),
         "tokens_per_s": round(n_tokens / elapsed, 1),
-        "p50_inter_token_ms": round(_pct(itl, 50) * 1e3, 3),
-        "p95_inter_token_ms": round(_pct(itl, 95) * 1e3, 3),
+        "p50_inter_token_ms": round(_pct(itl, 50) * 1e3, 3) if itl else None,
+        "p95_inter_token_ms": round(_pct(itl, 95) * 1e3, 3) if itl else None,
         "p50_ttft_ms": round(_pct(ttft, 50) * 1e3, 3),
         "p95_ttft_ms": round(_pct(ttft, 95) * 1e3, 3),
         "compiles_during_serve": int(compiles),
-    }, outs
+    }, outs, ttft
+
+
+def build_long_model(d_model=64, d_inner=128, max_length=256):
+    """A decode model whose geometry admits LONG prompts — the workload
+    where monolithic prefill's head-of-line block is visible.  The
+    --long-prompts leg sizes it up (d_model 256, T 512) so prefill is
+    COMPUTE-bound rather than dispatch-bound, as on a real chip."""
+    from paddle_tpu.models import transformer as T
+
+    params, meta = T.lm_params(seed=29, vocab_size=VOCAB, n_layer=2,
+                               n_head=4, d_model=d_model, d_inner=d_inner,
+                               max_length=max_length)
+    return T.build_decode_model(params, meta)
+
+
+def make_mixed_load(n_requests, interarrival_s, max_new, seed=1,
+                    n_long=4, long_len=(448, 504), short_len=(4, 24)):
+    """Mixed long/short open-loop load: ``n_long`` LONG prompts arrive
+    FIRST in a burst, a queue of short interactive prompts right behind
+    them — the canonical head-of-line-blocking shape (a batch job's
+    context dump landing just before the interactive traffic).  Arrivals
+    are open-loop (the schedule never waits for completions)."""
+    rng = np.random.RandomState(seed)
+    prompts = []
+    for i in range(n_requests):
+        lo, hi = long_len if i < n_long else short_len
+        prompts.append(rng.randint(1, VOCAB, size=rng.randint(lo, hi))
+                       .astype(np.int32))
+    # longs land together at t~0; shorts trickle in behind them while
+    # the long prefills are (monolithically) hogging the engine
+    arrivals = np.concatenate([
+        np.arange(n_long) * 2e-3,
+        0.05 + np.arange(n_requests - n_long) * interarrival_s,
+    ])
+    return prompts, arrivals, max_new
+
+
+def long_prompts_report(args):
+    """Chunked vs monolithic prefill under a mixed long/short load —
+    the decode-side head-of-line-blocking benchmark."""
+    n_req = args.requests or (24 if args.smoke else 32)
+    # a pure TTFT probe: one token per request, so the measurement is
+    # prefill scheduling alone (decode-throughput neutrality is the
+    # default --smoke leg's contract; chunked and monolithic share the
+    # identical compiled decode step)
+    max_new = args.max_new or 1
+    inter = (args.interarrival_ms
+             if args.interarrival_ms is not None else 12.0) / 1e3
+    chunk = args.chunk_tokens or 256
+    n_long = max(1, n_req // 3)
+    model = build_long_model(d_model=256, d_inner=512, max_length=512)
+    prompts, arrivals, max_new = make_mixed_load(
+        n_req, inter, max_new, n_long=n_long)
+    legs, outs = {}, {}
+    for name, kw in (("monolithic", {}),
+                     ("chunked", {"prefill_chunk_tokens": chunk})):
+        legs[name], outs[name], ttft_raw = run_leg(
+            model, prompts, arrivals, max_new, args.long_slots,
+            args.long_slots, page_size=16, max_seq_len=512, **kw)
+        # the interactive-latency number this leg exists for: TTFT of
+        # the SHORT prompts stuck behind the long burst (chunked prefill
+        # deliberately trades long-prompt TTFT for it, vLLM-style)
+        legs[name]["p95_short_ttft_ms"] = round(
+            _pct([ttft_raw[i] for i in range(n_req)
+                  if len(prompts[i]) < 100], 95) * 1e3, 3)
+    bitwise = all(a.tobytes() == b.tobytes()
+                  for a, b in zip(outs["monolithic"], outs["chunked"]))
+    ttft_gain = (legs["monolithic"]["p95_short_ttft_ms"]
+                 / legs["chunked"]["p95_short_ttft_ms"])
+    tps_ratio = (legs["chunked"]["tokens_per_s"]
+                 / legs["monolithic"]["tokens_per_s"])
+    report = {"decode_long_prompts": {
+        "workload": {
+            "requests": n_req, "long_prompts": n_long,
+            "max_new_tokens": max_new, "interarrival_ms": inter * 1e3,
+            "num_slots": args.long_slots, "prefill_chunk_tokens": chunk,
+            "open_loop": True,
+        },
+        "monolithic": legs["monolithic"],
+        "chunked": legs["chunked"],
+        "p95_short_ttft_gain": round(ttft_gain, 2),
+        "tokens_per_s_ratio": round(tps_ratio, 3),
+        "bitwise_equal": bool(bitwise),
+    }}
+    print(json.dumps(report, indent=2))
+    if args.smoke:
+        assert bitwise, "chunked prefill changed some sequence's tokens"
+        assert legs["chunked"]["compiles_during_serve"] == 0, (
+            "chunked leg served with a recompile: %r" % legs["chunked"])
+        assert ttft_gain >= 3.0, (
+            "chunked prefill short-prompt p95 TTFT gain %.2fx < 3x"
+            % ttft_gain)
+        # "no tokens/s regression": equal total work, different slicing —
+        # leave a 10%% floor for shared-CI scheduling noise
+        assert tps_ratio >= 0.9, (
+            "chunked prefill cost %.1f%% tokens/s" % ((1 - tps_ratio) * 100))
+    return 0
+
+
+def repeated_prefix_report(args):
+    """Prefix cache off vs on over a shared-prefix fan-out (one system
+    prompt, many tails) — the recomputation-avoided benchmark."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.executor import compile_count
+
+    n_req = args.requests or (10 if args.smoke else 32)
+    max_new = args.max_new or (8 if args.smoke else 16)
+    rng = np.random.RandomState(5)
+    prefix = rng.randint(1, VOCAB, size=112).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.randint(1, VOCAB, size=8)
+                               .astype(np.int32)])
+               for _ in range(n_req)]
+    model = build_long_model()
+    prefill_tokens = obs.counter("serving.decode.prefill_tokens")
+    hit_pages = obs.counter("serving.decode.kv_hit_pages")
+    miss_pages = obs.counter("serving.decode.kv_miss_pages")
+    legs, outs = {}, {}
+    for name, kw in (("cold", {}), ("warm", {"prefix_cache": True})):
+        sched = serving.DecodeScheduler(model, serving.DecodeConfig(
+            num_slots=args.slots, page_size=16, max_seq_len=256,
+            max_new_tokens=max_new, queue_capacity=max(256, 2 * n_req),
+            **kw))
+        c0 = compile_count()
+        p0, h0, m0 = prefill_tokens.value, hit_pages.value, miss_pages.value
+        t0 = time.perf_counter()
+        # sequential: each request completes before the next is admitted,
+        # so every fan-out request after the first sees the prefix cached
+        outs[name] = [sched.generate(p, timeout=600) for p in prompts]
+        elapsed = time.perf_counter() - t0
+        hits, misses = hit_pages.value - h0, miss_pages.value - m0
+        legs[name] = {
+            "requests": n_req,
+            "elapsed_s": round(elapsed, 4),
+            "prefill_tokens": prefill_tokens.value - p0,
+            "kv_hit_pages": hits,
+            "kv_miss_pages": misses,
+            "hit_rate": round(hits / (hits + misses), 3)
+            if hits + misses else 0.0,
+            "compiles_during_serve": compile_count() - c0,
+        }
+        sched.stop()
+    bitwise = all(a.tobytes() == b.tobytes()
+                  for a, b in zip(outs["cold"], outs["warm"]))
+    reduction = 1.0 - (legs["warm"]["prefill_tokens"]
+                       / legs["cold"]["prefill_tokens"])
+    report = {"decode_repeated_prefix": {
+        "workload": {
+            "requests": n_req, "prefix_tokens": int(prefix.shape[0]),
+            "tail_tokens": 8, "max_new_tokens": max_new,
+            "num_slots": args.slots,
+        },
+        "cold": legs["cold"],
+        "warm": legs["warm"],
+        "prefill_token_reduction": round(reduction, 3),
+        "bitwise_equal": bool(bitwise),
+    }}
+    print(json.dumps(report, indent=2))
+    if args.smoke:
+        assert bitwise, "prefix cache changed some sequence's tokens"
+        assert legs["warm"]["compiles_during_serve"] == 0, (
+            "warm leg served with a recompile: %r" % legs["warm"])
+        assert reduction >= 0.5, (
+            "prefix cache avoided only %.0f%% of prefill tokens"
+            % (reduction * 100))
+        assert legs["warm"]["hit_rate"] >= 0.5, legs["warm"]
+    return 0
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
                         help="small load + assertions (the CI gate)")
+    parser.add_argument("--long-prompts", action="store_true",
+                        help="mixed long/short leg: chunked vs "
+                             "monolithic prefill (p95 TTFT, tokens/s)")
+    parser.add_argument("--repeated-prefix", action="store_true",
+                        help="shared-prefix leg: prefix cache hit rate "
+                             "+ prefill-token reduction")
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--max-new", type=int, default=None)
     parser.add_argument("--interarrival-ms", type=float, default=None)
     parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--long-slots", type=int, default=12,
+                        help="num_slots for --long-prompts (> its long burst)")
+    parser.add_argument("--chunk-tokens", type=int, default=None,
+                        help="prefill chunk budget for --long-prompts")
     args = parser.parse_args(argv)
+
+    if args.long_prompts:
+        return long_prompts_report(args)
+    if args.repeated_prefix:
+        return repeated_prefix_report(args)
 
     n_req = args.requests or (24 if args.smoke else 64)
     max_new = args.max_new or (16 if args.smoke else 32)
@@ -136,7 +344,7 @@ def main(argv=None):
     # leg config (both legs share shapes, so the second leg is pre-warmed
     # at the jax level but still pays its own scheduler warmup)
     for name, active in (("naive", 1), ("continuous", args.slots)):
-        legs[name], outs[name] = run_leg(
+        legs[name], outs[name], _ = run_leg(
             model, prompts, arrivals, max_new, active, args.slots,
             page_size=16, max_seq_len=256)
     bitwise = all(a.tobytes() == b.tobytes()

@@ -91,3 +91,28 @@ def serving_payloads(n, width=8, seed=11):
     batching-order independent, hence deterministic."""
     rng = np.random.RandomState(seed)
     return [rng.randn(1, width).astype(np.float32) for _ in range(n)]
+
+
+def build_decode_prefix_model(seed=17):
+    """Seeded tiny decoder-only LM for the decode_prefix scenario (the
+    prefix-cache drift gate) — chunk-capable via build_decode_model."""
+    from paddle_tpu.models import transformer as T
+
+    params, meta = T.lm_params(seed=seed, vocab_size=50, n_layer=2,
+                               n_head=2, d_model=32, d_inner=64,
+                               max_length=128)
+    return T.build_decode_model(params, meta)
+
+
+def decode_prefix_prompts(n=5, prefix_tokens=24, tail_tokens=4, seed=19):
+    """A seeded shared-prefix fan-out: one common ``prefix_tokens``-long
+    system prompt + ``n`` distinct tails.  Served SEQUENTIALLY (each
+    request completes before the next is admitted) the page-hit /
+    prefill-token accounting is scheduling-order independent, hence
+    deterministic: request 1 misses everything, requests 2..n hit the
+    full reusable prefix."""
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(1, 50, size=prefix_tokens).astype(np.int32)
+    return [np.concatenate([prefix,
+                            rng.randint(1, 50, size=tail_tokens)
+                            .astype(np.int32)]) for _ in range(n)]

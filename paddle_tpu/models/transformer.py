@@ -648,6 +648,14 @@ def get_inference_model(
 # * ``lm_prefill``: the padded prompt in one causal pass (flash kernel on
 #   TPU, mha_reference elsewhere), returning per-layer K/V for the
 #   scheduler to scatter into pages + the last real token's logits.
+#   LEGACY — kept for chunk-less DecodeModels; the scheduler prefers:
+# * ``lm_prefill_chunk``: one resumable prefill CHUNK over the paged
+#   pool — scatter the window's k/v into the sequence's pages, attend
+#   through the page table over everything cached so far
+#   (``paged_prefill_attention``).  Chunked prefill, prefix-cache
+#   resume, AND monolithic prefill (one bucket-wide chunk) all run this
+#   step at one fixed attention key width, which is what makes them
+#   bitwise interchangeable.
 # * ``lm_decode_step``: one token per slot — project q/k/v, scatter k/v
 #   into each slot's current page/offset, attend over the slot's own
 #   pages (``paged_decode_attention``), finish the block stack, emit
@@ -749,6 +757,61 @@ def lm_prefill(params, tokens, length, *, n_head, use_flash=False):
     return last @ params["out_w"], jnp.stack(ks), jnp.stack(vs)
 
 
+def lm_prefill_chunk(params, tokens, start, valid, k_pool, v_pool,
+                     chunk_pages, gather_pages, *, n_head, attn_impl=None):
+    """One chunk of a prompt's prefill, resumable at any page boundary.
+
+    ``tokens``: [C] int32 — the chunk's token window (pad tail
+    arbitrary), absolute positions ``start .. start + C - 1``;
+    ``valid``: real tokens in this window (the final chunk's tail is
+    pad); ``chunk_pages``: [C // page_size] int32 page ids this chunk's
+    k/v scatter into (tail entries -> scratch); ``gather_pages``:
+    [max_pages] int32 — the sequence's FULL page-table row, what the
+    chunk attends over.  Returns ``(last_logits [V], k_pool', v_pool')``
+    with ``last_logits`` at row ``valid - 1`` (position
+    ``start + valid - 1`` — only the final chunk's is meaningful).
+
+    Per layer the chunk's k/v are scattered into the pool FIRST, then
+    attention gathers through the page table
+    (:func:`~paddle_tpu.parallel.flash_attention.paged_prefill_attention`)
+    — so a chunk sees every earlier chunk, any shared prefix-cache
+    pages, and itself, causally by absolute position.  The attention
+    key width is the fixed full-table span whatever the chunk size, and
+    every row is row-independent — which together make monolithic
+    (one chunk), chunked, and prefix-cache-resumed prefill bitwise
+    interchangeable.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.flash_attention import paged_prefill_attention
+
+    C = tokens.shape[0]
+    ps = k_pool.shape[2]
+    nb = C // ps
+    d_model = params["tok_emb"].shape[1]
+    dh = d_model // n_head
+    emb = jnp.asarray(params["tok_emb"])
+    pos_table = jnp.asarray(params["pos_table"])
+    positions = jnp.minimum(start + jnp.arange(C, dtype=jnp.int32),
+                            pos_table.shape[0] - 1)
+    x = emb[tokens] * np.sqrt(d_model) + pos_table[positions]
+    for li, lp in enumerate(params["layers"]):
+        q = (x @ lp["wq"]).reshape(C, n_head, dh)
+        k = (x @ lp["wk"]).reshape(C, n_head, dh)
+        v = (x @ lp["wv"]).reshape(C, n_head, dh)
+        k_pool = k_pool.at[li, chunk_pages].set(
+            k.reshape(nb, ps, n_head, dh).astype(k_pool.dtype))
+        v_pool = v_pool.at[li, chunk_pages].set(
+            v.reshape(nb, ps, n_head, dh).astype(v_pool.dtype))
+        ctx = paged_prefill_attention(q, k_pool[li], v_pool[li],
+                                      gather_pages, start, impl=attn_impl)
+        x = _lm_block_tail(lp, x, ctx.reshape(C, d_model))
+    last = jax.lax.dynamic_index_in_dim(x, valid - 1, axis=0,
+                                        keepdims=False)
+    return last @ params["out_w"], k_pool, v_pool
+
+
 def lm_decode_step(params, tokens, positions, k_pool, v_pool, page_tables,
                    kv_lens, *, n_head, attn_impl=None):
     """One decode iteration: token s of each slot at cache index
@@ -786,9 +849,11 @@ def build_decode_model(params, meta, eos_id=None, use_flash=None,
                        attn_impl=None):
     """Wrap LM weights as a serving ``DecodeModel``.
 
-    ``use_flash``: prefill attention engine (default: flash on TPU,
-    mha_reference elsewhere); ``attn_impl``: decode paged-attention
-    engine ("auto"/"reference"/"pallas", see paged_decode_attention).
+    ``use_flash``: LEGACY whole-prompt prefill attention engine (default:
+    flash on TPU, mha_reference elsewhere) — kept for ``prefill_fn``
+    compatibility; the scheduler prefers ``prefill_chunk_fn``, whose
+    paged attention engine is ``attn_impl`` ("auto"/"reference"/
+    "pallas", shared with the decode step's paged_decode_attention).
     """
     import jax
 
@@ -802,13 +867,19 @@ def build_decode_model(params, meta, eos_id=None, use_flash=None,
         return lm_prefill(params, tokens, length, n_head=n_head,
                           use_flash=use_flash)
 
+    def prefill_chunk_fn(tokens, start, valid, k_pool, v_pool, chunk_pages,
+                         gather_pages):
+        return lm_prefill_chunk(params, tokens, start, valid, k_pool,
+                                v_pool, chunk_pages, gather_pages,
+                                n_head=n_head, attn_impl=attn_impl)
+
     def decode_fn(tokens, positions, k_pool, v_pool, page_tables, kv_lens):
         return lm_decode_step(params, tokens, positions, k_pool, v_pool,
                               page_tables, kv_lens, n_head=n_head,
                               attn_impl=attn_impl)
 
     return DecodeModel(
-        prefill_fn, decode_fn,
+        prefill_fn, decode_fn, prefill_chunk_fn=prefill_chunk_fn,
         num_layers=meta["n_layer"], num_heads=n_head,
         head_dim=meta["head_dim"], vocab_size=meta["vocab_size"],
         eos_id=eos_id, name="transformer-lm")

@@ -36,7 +36,8 @@ import os
 import jax
 import numpy as np
 
-__all__ = ["flash_attention", "mha_reference", "paged_decode_attention"]
+__all__ = ["flash_attention", "mha_reference", "paged_decode_attention",
+           "paged_prefill_attention"]
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -942,6 +943,186 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, kv_lens, sm_scale, interpret):
         interpret=interpret,
     )(pt_flat, lens, q, k_pool, v_pool)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Prefill-shaped attention over the SAME paged pool: a CHUNK of query tokens
+# (absolute positions ``start .. start + C - 1`` of one sequence) against the
+# sequence's pages.  This is the kernel half of chunked prefill (ISSUE 15):
+# a long prompt is prefilled in fixed-size chunks interleaved with decode
+# iterations, each chunk writing its k/v into the sequence's pages and then
+# attending causally over EVERYTHING cached so far (earlier chunks, shared
+# prefix-cache pages, and itself).
+#
+# Bitwise discipline: every prefill path (monolithic single-chunk, chunked,
+# and prefix-cache resume) runs THIS attention at ONE fixed key width —
+# the full page-table span ``max_pages * page_size`` — because the key
+# width is part of the floating-point reduction shape: XLA's CPU backend
+# produces different last-bit sums for different reduction widths, so
+# "chunked == monolithic, bitwise" only holds when both sides reduce over
+# identically shaped (masked) key tensors.  Row count (the chunk length)
+# is NOT part of that contract — per-row results are row-independent, the
+# same property the serving bucket ladder already leans on.
+#
+# Engines mirror paged_decode_attention: a gather + masked-softmax
+# reference (CPU / tests), and a Pallas kernel whose k/v blocks are DMA'd
+# straight from the pool via the scalar-prefetched page table.
+# ---------------------------------------------------------------------------
+
+
+def _paged_prefill_reference(q, k_pool, v_pool, pages, start, sm_scale):
+    import jax.numpy as jnp
+
+    C, H, Dh = q.shape
+    ps = k_pool.shape[1]
+    mp = pages.shape[0]
+    k = k_pool[pages].reshape(mp * ps, H, Dh).astype(jnp.float32)
+    v = v_pool[pages].reshape(mp * ps, H, Dh).astype(jnp.float32)
+    s = jnp.einsum("chd,khd->chk", q.astype(jnp.float32), k) * sm_scale
+    # causal over CACHE order: query row i (absolute position start + i)
+    # sees keys [0, start + i] — its own prefix, itself included
+    lens = start + jnp.arange(C, dtype=jnp.int32) + 1
+    ok = jnp.arange(mp * ps)[None, :] < lens[:, None]  # [C, K]
+    s = jnp.where(ok[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("chk,khd->chd", p, v).astype(q.dtype)
+
+
+def _paged_prefill_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                          m_scr, l_scr, acc_scr, *, page_size,
+                          num_pages_per_seq, chunk, sm_scale):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)  # page walk (h rides grid dim 0)
+    start = start_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages wholly past the LAST query row's visibility (key index >=
+    # start + chunk) contribute nothing; skipping them is the whole point
+    # of walking pages instead of the padded max_seq_len rectangle
+    visible = j * page_size < start + chunk
+
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)        # [C, Dh]
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [ps, Dh]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        kcol = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)
+        # zero key/value rows past the chunk's visibility so stale page
+        # tails can't poison the p·v accumulation (0·garbage stays 0)
+        k = jnp.where(kcol < start + chunk, k, 0.0)
+        v = jnp.where(kcol < start + chunk, v, 0.0)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        row = jax.lax.broadcasted_iota(jnp.int32, (chunk, page_size), 0)
+        col = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (chunk, page_size), 1)
+        ok = col <= start + row  # causal by absolute position
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, 0:1] * alpha + p.sum(axis=1, keepdims=True), l_scr.shape)
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == num_pages_per_seq - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[0] = (acc_scr[:, :] / denom).astype(o_ref.dtype)
+
+
+def _paged_prefill_pallas(q, k_pool, v_pool, pages, start, sm_scale,
+                          interpret):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, H, Dh = q.shape
+    ps = k_pool.shape[1]
+    mp = pages.shape[0]
+    qh = q.transpose(1, 0, 2)  # [H, C, Dh]
+    pt = pages.astype(jnp.int32)
+    start_arr = jnp.reshape(jnp.asarray(start, jnp.int32), (1,))
+
+    kernel = functools.partial(
+        _paged_prefill_kernel, page_size=ps, num_pages_per_seq=mp,
+        chunk=C, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(H, mp),
+        in_specs=[
+            pl.BlockSpec((1, C, Dh), lambda h, j, pt, st: (h, 0, 0)),
+            pl.BlockSpec((1, ps, 1, Dh),
+                         lambda h, j, pt, st: (pt[j], 0, h, 0)),
+            pl.BlockSpec((1, ps, 1, Dh),
+                         lambda h, j, pt, st: (pt[j], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, C, Dh), lambda h, j, pt, st: (h, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((C, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((C, 128), jnp.float32),  # running sum
+            pltpu.VMEM((C, Dh), jnp.float32),   # output accumulator
+        ],
+    )
+    (out,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((H, C, Dh), q.dtype)],
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(pt, start_arr, qh, k_pool, v_pool)
+    return out.transpose(1, 0, 2)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, pages, start, sm_scale=None,
+                            impl=None, interpret=None):
+    """Chunk-of-prompt attention against one sequence's paged KV.
+
+    q: [C, H, Dh] — one prefill chunk's query tokens, absolute positions
+        ``start .. start + C - 1`` (pad tail rows allowed; their outputs
+        are garbage the caller ignores).
+    k_pool / v_pool: [num_pages, page_size, H, Dh] — ONE layer's pool;
+        the chunk's OWN k/v must already be scattered in.
+    pages: [max_pages] int32 — the sequence's full page-table row in
+        order; unused entries must point at a valid (scratch) page.
+    start: int32 scalar — absolute position of the chunk's first row.
+        Row i attends keys ``[0, start + i]`` (causal over cache order).
+    impl: None/"auto" (pallas on TPU, reference elsewhere), "reference",
+        or "pallas" (tests drive the kernel under interpret=True on CPU).
+
+    The key width is ALWAYS the full ``max_pages * page_size`` span —
+    fixed per cache geometry — so monolithic, chunked, and prefix-cache-
+    resumed prefill reduce over identically shaped key tensors and stay
+    bitwise interchangeable (see the section comment above).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if impl in (None, "auto"):
+        impl = "reference" if _infer_interpret(q) else "pallas"
+    if impl == "reference":
+        return _paged_prefill_reference(q, k_pool, v_pool, pages, start,
+                                        sm_scale)
+    if impl != "pallas":
+        raise ValueError("impl must be auto|reference|pallas, got %r" % impl)
+    if interpret is None:
+        interpret = _infer_interpret(q)
+    return _paged_prefill_pallas(q, k_pool, v_pool, pages, start, sm_scale,
+                                 interpret)
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_tables, kv_lens,

@@ -35,7 +35,14 @@ call ``generate()``/``generate_async()`` — continuous batching
 (iteration-level scheduling, Orca OSDI'22) over a paged KV cache
 (vLLM/PagedAttention SOSP'23), bitwise-equal to per-sequence serving
 with zero decode-step recompiles after warmup (decode_scheduler.py,
-kv_cache.py; docs/serving.md "Autoregressive decode").
+kv_cache.py; docs/serving.md "Autoregressive decode").  Long prompts
+prefill in fixed-budget CHUNKS interleaved with decode iterations
+(``DecodeConfig(prefill_chunk_tokens=...)`` — no more head-of-line
+blocking; deadlines shed between chunks), and repeated prompt prefixes
+map refcounted cached KV pages instead of recomputing
+(``prefix_cache=True``, content-hash index + LRU eviction) — both
+bitwise-neutral to the generated tokens (docs/serving.md "Chunked
+prefill & prefix caching").
 
 Adaptive request batching is the big serving-throughput lever on
 accelerators (Clipper NSDI'17, Orca OSDI'22), and on TPU/XLA it

@@ -13,10 +13,29 @@ batch composition changes every step, the compiled shape never does.
 Shape discipline (the TPU-native part, same philosophy as the predict
 path's bucket ladder):
 
-* **prefill** runs per sequence, padded to a page-multiple LENGTH bucket
-  ladder — one compiled program per bucket, warmed up front.  The
-  prompt's k/v land directly in the sequence's pages
-  (:mod:`~paddle_tpu.serving.kv_cache`).
+* **prefill** runs per sequence as a series of CHUNK steps over the paged
+  pool: each chunk scatters its page-multiple k/v window into the
+  sequence's pages, then attends (causally, by absolute position) over
+  everything cached so far through the page table.  With
+  ``DecodeConfig.prefill_chunk_tokens`` unset, a prompt is ONE chunk
+  padded to a page-multiple length-bucket ladder (the monolithic
+  behavior, one warmed program per bucket); set, prefill is split into
+  fixed-budget chunks and the scheduler runs AT MOST ONE chunk per
+  iteration, fewest-remaining-chunks first (admission order on ties),
+  interleaved with the decode step — so a long prompt no longer
+  head-of-line-blocks active decodes or short prompts behind it: TTFT
+  and inter-token latency are bounded by the chunk size, not the
+  longest prompt.  The chunk step is
+  one compiled program per chunk width, so the zero-recompile contract
+  holds with chunking on.
+* **prefix caching** (``DecodeConfig.prefix_cache=True``): admission
+  probes the KV cache's content-hash page index with the prompt's chain
+  hashes and maps any cached leading full pages read-only (refcounted —
+  see :mod:`~paddle_tpu.serving.kv_cache`); only the uncached tail is
+  prefilled, resuming chunk steps mid-prompt.  Repeated system prompts /
+  few-shot templates stop being recomputed; reuse shows up on
+  ``serving.decode.kv_hit_pages`` and prefilled work on
+  ``serving.decode.prefill_tokens``.
 * **decode** is a single ``[num_slots]`` program: embed one token per
   slot, scatter its k/v into the paged pool, attend over each slot's own
   pages (``paged_decode_attention``), greedy-sample the next token.
@@ -80,6 +99,8 @@ _queue_wait_hist = _obs.histogram("serving.decode.queue_wait")
 _ttft_hist = _obs.histogram("serving.decode.ttft")
 _step_hist = _obs.histogram("serving.decode.step")
 _prefill_retries = _obs.counter("serving.decode.prefill_retries")
+_prefill_tokens = _obs.counter("serving.decode.prefill_tokens")
+_expired_mid_prefill = _obs.counter("serving.decode.expired_mid_prefill")
 
 
 def _sample_token(logits, key, temp, top_k):
@@ -103,11 +124,23 @@ def _sample_token(logits, key, temp, top_k):
 
 
 class DecodeModel:
-    """The two pure-jax callables a decode-capable model exposes.
+    """The pure-jax callables a decode-capable model exposes.
 
     ``prefill_fn(tokens[T], length) -> (last_logits[V], k[L,T,H,D],
     v[L,T,H,D])`` — run the whole (padded) prompt; ``length`` is the real
     token count, ``last_logits`` the logits at position ``length - 1``.
+    LEGACY: used only by models that don't provide ``prefill_chunk_fn``.
+
+    ``prefill_chunk_fn(tokens[C], start, valid, k_pool, v_pool,
+    chunk_pages[C // page_size], gather_pages[MP]) ->
+    (last_logits[V], k_pool', v_pool')`` — one resumable prefill CHUNK:
+    scatter the window's k/v into ``chunk_pages``, attend over the
+    sequence's ``gather_pages`` causally by absolute position
+    (``start + row``); ``last_logits`` sits at row ``valid - 1``.  When
+    present the scheduler prefills EVERY prompt through this step
+    (monolithic = one bucket-wide chunk), which is what makes chunked,
+    monolithic, and prefix-cache-resumed prefill bitwise interchangeable
+    — and what ``prefill_chunk_tokens`` / ``prefix_cache`` require.
 
     ``decode_fn(tokens[S], positions[S], k_pool, v_pool,
     page_tables[S,MP], kv_lens[S]) -> (logits[S,V], k_pool', v_pool')`` —
@@ -115,15 +148,17 @@ class DecodeModel:
     pools, attend over each slot's first ``kv_lens`` cached tokens.
     ``kv_lens[s] == 0`` marks an inactive slot (masked, scratch writes).
 
-    Both are jitted by the scheduler (with pool donation on TPU); they
+    All are jitted by the scheduler (with pool donation on TPU); they
     must be shape-stable in everything but values.
     ``models.transformer.build_decode_model`` is the in-repo producer.
     """
 
-    def __init__(self, prefill_fn, decode_fn, *, num_layers, num_heads,
-                 head_dim, vocab_size, eos_id=None, name="decode-model"):
+    def __init__(self, prefill_fn, decode_fn, prefill_chunk_fn=None, *,
+                 num_layers, num_heads, head_dim, vocab_size, eos_id=None,
+                 name="decode-model"):
         self.prefill_fn = prefill_fn
         self.decode_fn = decode_fn
+        self.prefill_chunk_fn = prefill_chunk_fn
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
@@ -163,13 +198,31 @@ class DecodeConfig:
         attempt (functional writes) — unlike the in-place decode step;
         forced to 0 when pool donation is active (TPU), where a failed
         dispatch consumes the pools.
+    prefill_chunk_tokens: per-iteration prefill token budget.  None
+        (default) prefills each prompt as ONE chunk padded to the bucket
+        ladder — the monolithic behavior, where a long prompt
+        head-of-line-blocks the decode step for its whole prefill.  Set
+        to a page-size multiple to split prefill into fixed-budget
+        chunks run at most one per iteration, fewest remaining chunks
+        first (admission order on ties), interleaved with decode — TTFT
+        of short prompts and inter-token latency of active decodes
+        become bounded by the chunk size.  One compiled chunk program per width, so the
+        zero-recompile contract holds.  Requires the model to provide
+        ``prefill_chunk_fn``.
+    prefix_cache: probe the KV pool's content-hash page index at
+        admission and map cached prompt-prefix pages read-only instead
+        of recomputing them (refcounted sharing, LRU eviction of
+        refcount-zero pages — see kv_cache.py).  Requires
+        ``prefill_chunk_fn`` (a hit resumes prefill mid-prompt).
+        Generated tokens are bitwise identical warm vs cold.
     """
 
     def __init__(self, num_slots=4, page_size=16, max_seq_len=256,
                  num_pages=None, prefill_buckets=None, max_new_tokens=64,
                  max_active=None, queue_capacity=128,
                  default_deadline_ms=None, kv_dtype="float32", warmup=True,
-                 default_temperature=0.0, top_k=None, prefill_retries=2):
+                 default_temperature=0.0, top_k=None, prefill_retries=2,
+                 prefill_chunk_tokens=None, prefix_cache=False):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.max_seq_len = int(max_seq_len)
@@ -185,6 +238,16 @@ class DecodeConfig:
         self.default_temperature = float(default_temperature)
         self.top_k = None if top_k is None else int(top_k)
         self.prefill_retries = int(prefill_retries)
+        self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
+                                     else int(prefill_chunk_tokens))
+        self.prefix_cache = bool(prefix_cache)
+        if self.prefill_chunk_tokens is not None:
+            if (self.prefill_chunk_tokens < self.page_size
+                    or self.prefill_chunk_tokens % self.page_size):
+                raise ValueError(
+                    "prefill_chunk_tokens must be a positive multiple of "
+                    "page_size %d, got %r"
+                    % (self.page_size, prefill_chunk_tokens))
         if self.default_temperature < 0:
             raise ValueError("default_temperature must be >= 0")
         if self.top_k is not None and self.top_k < 1:
@@ -234,16 +297,35 @@ class GenerateRequest(Request):
 
 
 class _Slot:
-    """Worker-private state of one active sequence."""
+    """Worker-private state of one active sequence.
 
-    __slots__ = ("req", "pages", "prompt_len", "kv_len", "generated")
+    A chunk-prefilled sequence enters in the PREFILLING state:
+    ``prefill_pos`` tracks prompt tokens already cached (starting past
+    any prefix-cache hit) and advances one chunk per scheduled
+    iteration; the first sampled token (produced by the final chunk)
+    flips it to decoding.  The legacy whole-prompt path constructs the
+    slot already past prefill.
+    """
 
-    def __init__(self, req, pages):
+    __slots__ = ("req", "pages", "prompt_len", "kv_len", "generated",
+                 "prefill_pos", "hashes")
+
+    def __init__(self, req, pages, prefill_pos=None, hashes=None):
         self.req = req
         self.pages = pages
         self.prompt_len = req.prompt_len
-        self.kv_len = req.prompt_len   # tokens written to the paged cache
+        # tokens written to the paged cache so far
+        self.kv_len = (req.prompt_len if prefill_pos is None
+                       else int(prefill_pos))
         self.generated = []            # sampled tokens (last one not yet fed)
+        self.prefill_pos = (req.prompt_len if prefill_pos is None
+                            else int(prefill_pos))
+        self.hashes = hashes           # prompt chain hashes (prefix cache)
+
+    @property
+    def prefilling(self):
+        """True until the final chunk has produced the first token."""
+        return self.prefill_pos < self.prompt_len or not self.generated
 
 
 class DecodeScheduler:
@@ -259,6 +341,13 @@ class DecodeScheduler:
 
         self.model = model
         cfg = self.config = config or DecodeConfig()
+        self._use_chunks = model.prefill_chunk_fn is not None
+        if not self._use_chunks and (cfg.prefill_chunk_tokens is not None
+                                     or cfg.prefix_cache):
+            raise ServingError(
+                "prefill_chunk_tokens / prefix_cache require a model with "
+                "prefill_chunk_fn (see models.transformer."
+                "build_decode_model); %r has none" % (model.name,))
         self._cache = PagedKVCache(
             model.num_layers,
             cfg.num_pages or (
@@ -358,6 +447,26 @@ class DecodeScheduler:
 
             return jax.jit(decode, donate_argnums=donate)
 
+        if key[0] == "chunk":
+            def chunk(tokens, start, valid, k_pool, v_pool, chunk_pages,
+                      gather_pages, seed, temp):
+                logits, k_pool, v_pool = model.prefill_chunk_fn(
+                    tokens, start, valid, k_pool, v_pool, chunk_pages,
+                    gather_pages)
+                # the first generated token sits at absolute position
+                # start + valid; only the FINAL chunk's sample is used,
+                # and there it folds exactly like the legacy prefill's
+                # fold at `length` — same logits row, same key, so
+                # chunked and monolithic first tokens match bitwise
+                kk = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                        start + valid)
+                return (_sample_token(logits, kk, temp, top_k),
+                        k_pool, v_pool)
+
+            # donate the pools (positions 3, 4) on TPU, as elsewhere
+            return jax.jit(chunk,
+                           donate_argnums=(3, 4) if donate else ())
+
         def prefill(tokens, length, k_pool, v_pool, pages, seed, temp):
             logits, k, v = model.prefill_fn(tokens, length)
             k_pool, v_pool = write_prompt_kv(k_pool, v_pool, k, v, pages)
@@ -367,8 +476,23 @@ class DecodeScheduler:
 
         return jax.jit(prefill, donate_argnums=donate)
 
+    def _chunk_widths(self):
+        """The prefill-chunk widths this config can dispatch.
+        Monolithic (no chunk budget): the bucket ladder — a prompt uses
+        its bucket, a prefix-cache resume the smallest bucket covering
+        the uncached tail.  Chunked: the budget width plus every SMALLER
+        ladder bucket — a remaining prefill under the budget dispatches
+        at its own bucket instead of padding to the full budget (a
+        10-token prompt must not pay a 256-wide chunk), so the menu
+        stays a small fixed warmed set either way."""
+        if self.config.prefill_chunk_tokens is None:
+            return self.prefill_buckets
+        ct = self.config.prefill_chunk_tokens
+        return tuple(sorted({b for b in self.prefill_buckets if b < ct}
+                            | {ct}))
+
     def warmup(self):
-        """Compile the decode step and every prefill bucket against the
+        """Compile the decode step and every prefill width against the
         scratch page, so no live sequence ever pays a compile."""
         import jax.numpy as jnp
 
@@ -385,15 +509,29 @@ class DecodeScheduler:
                 jnp.zeros((cfg.num_slots,), jnp.float32))
             np.asarray(toks)
             self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
-            for b in self.prefill_buckets:
-                fn = self._jit.get(("prefill", b))
-                toks, k_pool, v_pool = fn(
-                    jnp.zeros((b,), jnp.int32), jnp.int32(1),
-                    self._cache.k_pool, self._cache.v_pool,
-                    jnp.zeros((b // cfg.page_size,), jnp.int32),
-                    jnp.uint32(0), jnp.float32(0))
-                np.asarray(toks)
-                self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+            if self._use_chunks:
+                for w in self._chunk_widths():
+                    fn = self._jit.get(("chunk", w))
+                    toks, k_pool, v_pool = fn(
+                        jnp.zeros((w,), jnp.int32), jnp.int32(0),
+                        jnp.int32(1),
+                        self._cache.k_pool, self._cache.v_pool,
+                        jnp.zeros((w // cfg.page_size,), jnp.int32),
+                        jnp.zeros((self._cache.max_pages_per_seq,),
+                                  jnp.int32),
+                        jnp.uint32(0), jnp.float32(0))
+                    np.asarray(toks)
+                    self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+            else:
+                for b in self.prefill_buckets:
+                    fn = self._jit.get(("prefill", b))
+                    toks, k_pool, v_pool = fn(
+                        jnp.zeros((b,), jnp.int32), jnp.int32(1),
+                        self._cache.k_pool, self._cache.v_pool,
+                        jnp.zeros((b // cfg.page_size,), jnp.int32),
+                        jnp.uint32(0), jnp.float32(0))
+                    np.asarray(toks)
+                    self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
         return self
 
     # -- lifecycle -----------------------------------------------------------
@@ -465,7 +603,11 @@ class DecodeScheduler:
             # thread just failed)
             hol = self._take_hol()
             if hol is not None:
-                hol.fail(ServingClosed(
+                # fail the future only: the wedged-but-alive worker still
+                # owns the cache, so the pinned prefix refs are leaked
+                # deliberately rather than freed from this thread (the
+                # scheduler is terminally wedged either way)
+                hol[0].fail(ServingClosed(
                     "engine stopped before request ran (decode worker "
                     "wedged)"))
             self._queue.drain_remaining(lambda r: ServingClosed(
@@ -525,10 +667,12 @@ class DecodeScheduler:
 
     def stats(self):
         active = sum(1 for s in self._slots if s is not None)
-        return {
+        st = {
             "num_slots": self.config.num_slots,
             "max_active": self.config.max_active,
             "active": active,
+            "prefilling": sum(1 for s in self._slots
+                              if s is not None and s.prefilling),
             "queue_depth": self._queue.depth(),
             "admitted": self._queue.last_seq(),
             "completed": self._completed,
@@ -536,7 +680,12 @@ class DecodeScheduler:
             "kv_pages_used": self._cache.used_pages,
             "kv_occupancy": self._cache.occupancy(),
             "prefill_buckets": list(self.prefill_buckets),
+            "prefill_chunk_tokens": self.config.prefill_chunk_tokens,
+            "prefix_cache": self.config.prefix_cache,
         }
+        if self.config.prefix_cache:
+            st["prefix"] = self._cache.prefix_stats()
+        return st
 
     # -- worker --------------------------------------------------------------
     def _sampling_params(self, req):
@@ -565,21 +714,33 @@ class DecodeScheduler:
         self._cache.reset_pools()
 
     def _take_hol(self):
-        """Exclusively claim the parked head-of-line request (or None):
-        the worker, a wedged-timeout stop(), and _fail_all all hand off
-        through here so exactly one owner ever fails/serves it."""
+        """Exclusively claim the parked head-of-line entry — a
+        ``(request, pinned prefix pages, chain hashes)`` triple — or
+        None: the worker, a wedged-timeout stop(), and _fail_all all
+        hand off through here so exactly one owner ever fails/serves
+        it."""
         with self._hol_lock:
-            req, self._hol = self._hol, None
-            return req
+            entry, self._hol = self._hol, None
+            return entry
 
-    def _park_hol(self, req):
+    def _park_hol(self, req, cached_pages, hashes):
+        """Park the head-of-line request WITH its prefix-probe result:
+        the hit pages stay rc-PINNED while parked, so the request isn't
+        re-probed (and the hit/miss counters not re-counted) every
+        iteration the pool stays exhausted, and its prefix can't be
+        evicted out from under the admission it is queued for."""
         with self._hol_lock:
-            self._hol = req
+            self._hol = (req, cached_pages, hashes)
 
     def _fail_all(self, exc):
         hol = self._take_hol()
         if hol is not None:
-            hol.fail(exc)
+            req, cached_pages, _ = hol
+            if cached_pages:
+                # safe here: _fail_all runs on the worker thread or with
+                # the worker provably dead (fail_pending/stop enforce it)
+                self._cache.release_prefix(cached_pages)
+            req.fail(exc)
         self._queue.drain_remaining(lambda r: exc)
         for i, slot in enumerate(self._slots):
             if slot is not None:
@@ -639,13 +800,18 @@ class DecodeScheduler:
         while self._active_count() < cfg.max_active:
             if self._worker.stopping and not self._drain:
                 return
-            req = self._take_hol()
-            if req is None:
+            hol = self._take_hol()
+            if hol is not None:
+                req, cached_pages, hashes = hol
+            else:
                 req = self._queue.get(
                     timeout=0.0 if self._active_count() else 0.05)
+                cached_pages, hashes = [], None
             if req is None:
                 return
             if req.expired():
+                if cached_pages:
+                    cache.release_prefix(cached_pages)
                 _expired.inc()
                 req.fail(ServingTimeout(
                     "deadline expired after %.3fs in decode queue"
@@ -653,11 +819,22 @@ class DecodeScheduler:
                 self._completed += 1
                 continue
             need = cache.pages_for(req.prompt_len + req.max_new_tokens)
-            pages = cache.alloc(need)
+            if cfg.prefix_cache and hashes is None:
+                # probe ONCE, before the fresh alloc: hits shrink the
+                # fresh reservation and stay rc-pinned (a re-parked
+                # request carries its probe result instead of
+                # re-counting hits every exhausted iteration)
+                cached_pages, hashes = cache.lookup_prefix(req.prompt)
+            pages = cache.alloc(need - len(cached_pages))
             if pages is None:
-                if not self._active_count() and need > cache.free_pages:
+                # pinned hit pages are NOT in free_pages — count them
+                # toward what this reservation can ever assemble
+                if (not self._active_count()
+                        and need > cache.free_pages + len(cached_pages)):
                     # nothing will ever free enough: the reservation is
                     # larger than the whole (idle) pool
+                    if cached_pages:
+                        cache.release_prefix(cached_pages)
                     req.fail(ServingError(
                         "sequence needs %d pages but the pool has %d "
                         "usable; raise num_pages or shrink the request"
@@ -666,9 +843,172 @@ class DecodeScheduler:
                     continue
                 # pool exhausted: hold the head (FIFO) until a retirement
                 # frees its reservation
-                self._park_hol(req)
+                self._park_hol(req, cached_pages, hashes)
                 return
-            self._prefill(req, pages)
+            if self._use_chunks:
+                self._place(req, cached_pages + pages,
+                            len(cached_pages) * cfg.page_size, hashes)
+            else:
+                self._prefill(req, pages)
+
+    def _place(self, req, pages, cached_tokens, hashes):
+        """Seat one admitted request in a free slot in the PREFILLING
+        state (chunk path): pages are reserved (``cached_tokens`` of
+        them already hold a shared prompt prefix), but no model compute
+        happens here — chunks run one per iteration in ``_iterate``,
+        so a burst of long-prompt admissions can't stall active
+        decodes behind back-to-back whole-prompt prefills."""
+        idx = next(i for i, s in enumerate(self._slots) if s is None)
+        now = time.perf_counter()
+        wait = now - req.enqueue_ts
+        _queue_wait.observe(wait)
+        _queue_wait_hist.observe(wait)
+        req.dispatch_ts = now
+        tel = self._telemetry
+        if tel.span_active() and req.trace is not None:
+            tel.record_span(
+                "serving.queue_wait", req.enqueue_wall, wait,
+                tags=req.trace.child().tags(priority=req.priority,
+                                            seq=req.seq))
+        slot = _Slot(req, pages, prefill_pos=cached_tokens, hashes=hashes)
+        self._slots[idx] = slot
+        self._tables[idx] = self._cache.table_row(pages)
+        _active_slots.set(self._active_count())
+
+    def _note_prefill_retry(self, req):
+        """The shared on_retry callback for BOTH prefill legs (legacy
+        whole-prompt and chunk): count, record, and trace one retried
+        transient prefill dispatch fault — one place so the record
+        shape can't drift between the legs."""
+        def note_retry(exc, attempt_n, delay):
+            _prefill_retries.inc()
+            tel = self._telemetry
+            if tel.recording:
+                tel.emit({
+                    "type": "serving_retry", "ts": time.time(),
+                    "source": "serving", "leg": "decode_prefill",
+                    "error": repr(exc)[:200], "attempt": attempt_n,
+                    "delay_s": delay, "seq": req.seq,
+                })
+            if tel.span_active() and req.trace is not None:
+                tel.record_span(
+                    "serving.retry", time.time(), 0.0,
+                    tags=req.trace.child().tags(leg="decode_prefill",
+                                                attempt=attempt_n,
+                                                error=repr(exc)[:120]))
+        return note_retry
+
+    def _chunk_width_for(self, remaining):
+        """Dispatch width for a chunk with ``remaining`` prompt tokens
+        left: the chunk budget, except a smaller remainder rides its
+        own (warmed) bucket — see :meth:`_chunk_widths`."""
+        ct = self.config.prefill_chunk_tokens
+        if ct is None:
+            return next(b for b in self.prefill_buckets if b >= remaining)
+        if remaining >= ct:
+            return ct
+        b = next((b for b in self.prefill_buckets if b >= remaining), ct)
+        return min(ct, b)
+
+    def _chunks_left(self, slot):
+        remaining = slot.prompt_len - slot.prefill_pos
+        return -(-remaining // self._chunk_width_for(remaining))
+
+    def _chunk_step(self, idx):
+        """Run ONE prefill chunk for the slot at ``idx``: scatter the
+        next page-multiple token window's k/v, attend over everything
+        cached so far, and — on the final chunk — sample the first
+        token (flipping the slot to decoding)."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        slot = self._slots[idx]
+        req = slot.req
+        start = slot.prefill_pos
+        remaining = req.prompt_len - start
+        width = self._chunk_width_for(remaining)
+        valid = min(remaining, width)
+        ps = cfg.page_size
+        tokens = np.zeros((width,), np.int32)
+        tokens[:valid] = req.prompt[start:start + valid]
+        # pages this chunk writes: the prompt's pages covering
+        # [start, start + width); window tail past the prompt's pages
+        # scatters to scratch, exactly like the monolithic pad tail
+        n_prompt_pages = self._cache.pages_for(req.prompt_len)
+        p0 = start // ps
+        chunk_vec = np.zeros((width // ps,), np.int32)
+        for i in range(width // ps):
+            if p0 + i < n_prompt_pages:
+                chunk_vec[i] = slot.pages[p0 + i]
+        fn = self._jit.get(("chunk", width))
+        temp, seed = self._sampling_params(req)
+        t0 = time.perf_counter()
+
+        def attempt():
+            # the chaos choke point is consulted per ATTEMPT (a retry is
+            # a fresh dispatch, exactly like the predict path's)
+            serve_fault = _resilience._serve_fault
+            if serve_fault is not None:
+                serve_fault([req])
+            with self._telemetry.timed("serving.decode.prefill",
+                                       bucket=width, rows=valid,
+                                       start=start, seq=req.seq):
+                tok, kp, vp = fn(
+                    jnp.asarray(tokens), jnp.int32(start),
+                    jnp.int32(valid),
+                    self._cache.k_pool, self._cache.v_pool,
+                    jnp.asarray(chunk_vec),
+                    jnp.asarray(self._tables[idx]), seed, temp)
+                return int(np.asarray(tok)), kp, vp
+
+        try:
+            chunk_wall = time.time()
+            first, k_pool, v_pool = _resilience.call_with_retry(
+                attempt, policy=self._prefill_policy,
+                on_retry=self._note_prefill_retry(req))
+        except Exception as exc:  # noqa: BLE001 — worker must survive
+            self._retire(idx, error=exc)
+            self._recover_pools(exc)
+            return
+        except BaseException:
+            # worker killed mid-chunk: fail the sequence and release its
+            # reservation before the death propagates.  ServingDegraded
+            # (not ServingError): the engine is sick, the request was
+            # fine — same taxonomy as the batcher death
+            self._retire(idx, error=ServingDegraded(
+                "decode worker died mid-prefill; request aborted"))
+            raise
+        done = time.perf_counter()
+        _prefill_timer.observe(done - t0)
+        tel = self._telemetry
+        if tel.span_active() and req.trace is not None:
+            tel.record_span(
+                "serving.execute", chunk_wall, done - t0,
+                tags=req.trace.child().tags(phase="prefill", bucket=width,
+                                            rows=valid, start=start))
+        self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        slot.prefill_pos = start + valid
+        slot.kv_len = slot.prefill_pos
+        _prefills.inc()
+        _prefill_tokens.inc(valid)
+        if cfg.prefix_cache and slot.hashes:
+            # publish every full REAL page this chunk completed: its
+            # content is now immutable (decode appends only past the
+            # prompt), so later identical prefixes can map it read-only
+            for pi in range(p0, (start + valid) // ps):
+                if pi < len(slot.hashes):
+                    self._cache.register_prefix(slot.hashes, pi,
+                                                slot.pages[pi])
+        if slot.prefill_pos >= req.prompt_len:
+            # final chunk: the sampled token at position prompt_len - 1
+            # is the sequence's first generated token
+            slot.generated.append(first)
+            req.token_times.append(time.perf_counter())
+            # TTFT: admission -> first sampled token, the number an
+            # interactive-decode SLO is written against
+            _ttft_hist.observe(done - req.enqueue_ts)
+            _tokens.inc()
+            self._finish_if_done(idx)
 
     def _prefill(self, req, pages):
         import jax.numpy as jnp
@@ -710,27 +1050,11 @@ class DecodeScheduler:
                     jnp.asarray(page_vec), seed, temp)
                 return int(np.asarray(tok)), kp, vp
 
-        def note_retry(exc, attempt_n, delay):
-            _prefill_retries.inc()
-            tel = self._telemetry
-            if tel.recording:
-                tel.emit({
-                    "type": "serving_retry", "ts": time.time(),
-                    "source": "serving", "leg": "decode_prefill",
-                    "error": repr(exc)[:200], "attempt": attempt_n,
-                    "delay_s": delay, "seq": req.seq,
-                })
-            if tel.span_active() and req.trace is not None:
-                tel.record_span(
-                    "serving.retry", time.time(), 0.0,
-                    tags=req.trace.child().tags(leg="decode_prefill",
-                                                attempt=attempt_n,
-                                                error=repr(exc)[:120]))
-
         try:
             prefill_wall = time.time()
             first, k_pool, v_pool = _resilience.call_with_retry(
-                attempt, policy=self._prefill_policy, on_retry=note_retry)
+                attempt, policy=self._prefill_policy,
+                on_retry=self._note_prefill_retry(req))
         except Exception as exc:  # noqa: BLE001 — worker must survive
             self._cache.free(pages)
             self._completed += 1
@@ -782,24 +1106,57 @@ class DecodeScheduler:
         import jax.numpy as jnp
 
         cfg = self.config
-        # shed actives whose deadline passed before burning a step on them
+        # shed actives whose deadline passed before burning a step on
+        # them — checked BETWEEN chunks too, so a doomed long prompt
+        # frees its budget early instead of prefilling to completion
         now0 = time.perf_counter()
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.expired(now0):
                 req = slot.req
                 queued_s = ((req.dispatch_ts or now0) - req.enqueue_ts
                             if req.enqueue_ts is not None else 0.0)
-                decoding_s = (now0 - req.dispatch_ts
-                              if req.dispatch_ts is not None else 0.0)
+                running_s = (now0 - req.dispatch_ts
+                             if req.dispatch_ts is not None else 0.0)
                 _expired.inc()
-                _expired_mid_decode.inc()
-                self._retire(i, error=ServingTimeout(
-                    "deadline expired mid-decode after %d/%d generated "
-                    "tokens (%.3fs in queue, %.3fs decoding)"
-                    % (len(slot.generated), req.max_new_tokens,
-                       max(0.0, queued_s), max(0.0, decoding_s))))
-        active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+                if slot.prefilling:
+                    _expired_mid_prefill.inc()
+                    err = ServingTimeout(
+                        "deadline expired mid-prefill after %d/%d prompt "
+                        "tokens (%.3fs in queue, %.3fs in prefill)"
+                        % (slot.prefill_pos, slot.prompt_len,
+                           max(0.0, queued_s), max(0.0, running_s)))
+                else:
+                    _expired_mid_decode.inc()
+                    err = ServingTimeout(
+                        "deadline expired mid-decode after %d/%d generated "
+                        "tokens (%.3fs in queue, %.3fs decoding)"
+                        % (len(slot.generated), req.max_new_tokens,
+                           max(0.0, queued_s), max(0.0, running_s)))
+                self._retire(i, error=err)
+        # chunked prefill phase: AT MOST ONE chunk per iteration, so
+        # prefill work interleaves with (never starves) the decode step
+        # below.  Pick order: FEWEST REMAINING CHUNKS first, admission
+        # order (seq) on ties — a short prompt's single chunk runs ahead
+        # of a long prompt's many, which is exactly what bounds short
+        # TTFT by the chunk size instead of the longest neighbor.  With
+        # monolithic prefill every slot has exactly one chunk left, so
+        # the tiebreak degrades to pure admission-order FIFO (the PR-6
+        # behavior).  A sustained flood of shorter prefills can delay a
+        # longer one (bounded by the seat cap: each shorter request
+        # holds a slot and runs exactly one winning chunk per iteration);
+        # admission stays FIFO-per-priority-lane either way.
+        prefilling = [i for i, s in enumerate(self._slots)
+                      if s is not None and s.prefilling]
+        if prefilling:
+            self._chunk_step(min(
+                prefilling,
+                key=lambda i: (self._chunks_left(self._slots[i]),
+                               self._slots[i].req.seq)))
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None and not s.prefilling]
         if not active:
+            self._cache.publish_gauges(
+                sum(s.kv_len for s in self._slots if s is not None))
             return
         tokens = np.zeros((cfg.num_slots,), np.int32)
         positions = np.zeros((cfg.num_slots,), np.int32)
@@ -811,6 +1168,18 @@ class DecodeScheduler:
             positions[i] = slot.kv_len       # ... at the next cache index
             kv_lens[i] = slot.kv_len + 1     # visible kv incl. this token
             temps[i], seeds[i] = self._sampling_params(slot.req)
+        # the decode step scatters EVERY slot's token k/v at
+        # page_tables[s, 0] offset 0 when positions[s] == 0 — a
+        # PREFILLING slot's table already points at real (possibly
+        # SHARED prefix) pages, so its dispatch row must aim at scratch
+        # like any other non-decoding slot or the write corrupts
+        # position 0 of its (or a prefix neighbor's) cache
+        tables = self._tables
+        masked = [i for i, s in enumerate(self._slots)
+                  if s is not None and s.prefilling]
+        if masked:
+            tables = self._tables.copy()
+            tables[masked] = 0
         fn = self._jit.get(("decode",))
         t0 = time.perf_counter()
         try:
@@ -822,7 +1191,7 @@ class DecodeScheduler:
                 out, k_pool, v_pool = fn(
                     jnp.asarray(tokens), jnp.asarray(positions),
                     self._cache.k_pool, self._cache.v_pool,
-                    jnp.asarray(self._tables), jnp.asarray(kv_lens),
+                    jnp.asarray(tables), jnp.asarray(kv_lens),
                     jnp.asarray(seeds), jnp.asarray(temps))
                 sampled = np.asarray(out)
         except Exception as exc:  # noqa: BLE001 — worker must survive

@@ -311,7 +311,10 @@ class InferenceEngine:
         ``predict``.  ``model_dir`` may be None for a generate-only
         engine.
     decode_config: :class:`~.decode_scheduler.DecodeConfig` for the
-        decode runtime (slots, KV paging geometry, prefill buckets).
+        decode runtime (slots, KV paging geometry, prefill buckets,
+        chunked prefill via ``prefill_chunk_tokens``, KV prefix reuse
+        via ``prefix_cache`` — docs/serving.md "Chunked prefill &
+        prefix caching").
     batch_timeout_ms: extra time the batcher may wait, measured from the
         head request's ARRIVAL, to fill a batch.  The default 0 is eager
         (dispatch whatever is queued — throughput-optimal under backlog

@@ -17,14 +17,39 @@ Allocation discipline (decode_scheduler.py is the only caller):
   mid-flight pool exhaustion — a request that doesn't fit simply waits in
   the admission queue.  The cost is internal fragmentation (reserved but
   not-yet-written slots), published as a gauge rather than hidden.
-* **free-on-retire**: the whole reservation returns to the free list the
-  moment the sequence finishes/sheds.  Freed pages are NOT scrubbed —
-  stale values are unreachable because every read masks by the owning
-  sequence's ``kv_lens``.
+* **free-on-retire**: the whole reservation returns the moment the
+  sequence finishes/sheds.  Freed pages are NOT scrubbed — stale values
+  are unreachable because every read masks by the owning sequence's
+  ``kv_lens``.
 * **page 0 is the scratch page**: never allocated.  Inactive decode slots
   point their whole page table at it, so the fixed-shape decode step can
   unconditionally scatter its per-slot k/v write — inactive slots write
   garbage to scratch instead of needing a ragged dispatch.
+
+**Prefix caching** (ISSUE 15) layers block-level KV *sharing* on top —
+the vLLM move of treating the page pool as a content-addressed cache:
+
+* every page is REFCOUNTED; ``alloc`` hands out rc=1 pages, a prefix hit
+  increfs, ``free`` decrefs, and a page is reusable only at rc=0.
+* a **content-hash index** maps a chain hash — hashed over whole
+  page-size token blocks, each link folding in the previous page's hash,
+  so a hit certifies the entire prefix, not just one block — to the page
+  holding that block's K/V.  Only FULL pages are ever indexed: a partial
+  page still has decode tokens appended, a full prefix page is immutable
+  (append-only while shared), so copy-on-write is never needed.
+* ``lookup_prefix`` walks a prompt's leading full pages through the
+  index and increfs the hits; the scheduler maps them read-only and
+  prefills only the tail.  ``register_prefix`` publishes freshly
+  written full pages.
+* rc=0 pages whose content is indexed are not freed — they park in an
+  **LRU** list and keep answering hits until capacity pressure evicts
+  them (``alloc`` evicts least-recently-used rc=0 pages after the plain
+  free list runs dry, dropping their index entries).
+
+Reuse is observable: ``serving.decode.kv_hit_pages`` /
+``kv_miss_pages`` count probe outcomes, ``kv_evictions`` counts
+capacity evictions, ``kv_shared_pages`` gauges pages live in 2+ page
+tables right now, and ``kv_cached_pages`` gauges the rc=0 LRU pool.
 
 The pools are jax arrays updated FUNCTIONALLY (``x.at[...].set``) by the
 pure helpers below, which the scheduler jits into its prefill/decode
@@ -34,6 +59,7 @@ allocator state and telemetry gauges (``serving.decode.kv_*``).
 from __future__ import annotations
 
 import collections
+import hashlib
 
 import numpy as np
 
@@ -46,6 +72,11 @@ _pages_total = _obs.gauge("serving.decode.kv_pages_total")
 _pages_used = _obs.gauge("serving.decode.kv_pages_used")
 _occupancy = _obs.gauge("serving.decode.kv_occupancy")
 _fragmentation = _obs.gauge("serving.decode.kv_fragmentation")
+_hit_pages = _obs.counter("serving.decode.kv_hit_pages")
+_miss_pages = _obs.counter("serving.decode.kv_miss_pages")
+_evictions = _obs.counter("serving.decode.kv_evictions")
+_shared_pages = _obs.gauge("serving.decode.kv_shared_pages")
+_cached_pages = _obs.gauge("serving.decode.kv_cached_pages")
 
 
 def write_prompt_kv(k_pool, v_pool, k_new, v_new, pages):
@@ -78,7 +109,7 @@ def write_token_kv(k_pool, v_pool, k_tok, v_tok, pages, offsets):
 
 
 class PagedKVCache:
-    """Preallocated paged pools + the host-side page allocator.
+    """Preallocated paged pools + the host-side refcounting allocator.
 
     Parameters
     ----------
@@ -117,50 +148,206 @@ class PagedKVCache:
         # page 0 = scratch; everything else starts free
         self._free = collections.deque(range(1, self.num_pages))
         self._used = 0
+        self._rc = [0] * self.num_pages
+        # prefix-cache state: chain hash -> page id, its inverse, and the
+        # rc=0-but-still-indexed pages in least-recently-used order
+        self._index = {}
+        self._hash_of_page = {}
+        self._lru = collections.OrderedDict()
+        # per-INSTANCE probe accounting (the serving.decode.kv_* counters
+        # are process-wide and would cross-contaminate co-hosted caches)
+        self._hits = 0
+        self._misses = 0
+        self._evicted = 0
+        # incrementally maintained rc>=2 count: shared_pages is read on
+        # every admission, and an O(num_pages) scan there would put a
+        # pool-sized interpreted loop on the serving hot path
+        self._shared = 0
         _pages_total.set(self.num_pages - 1)
         self._publish(0)
 
     def reset_pools(self):
         """Reallocate zeroed pools (allocator state untouched).  The
         recovery path after a failed DONATED dispatch, whose consumed
-        input buffers are gone either way."""
+        input buffers are gone either way.  The prefix index is FLUSHED —
+        its entries describe page contents that no longer exist."""
         import jax.numpy as jnp
 
         shape = (self.num_layers, self.num_pages, self.page_size,
                  self.num_heads, self.head_dim)
         self.k_pool = jnp.zeros(shape, self.dtype)
         self.v_pool = jnp.zeros(shape, self.dtype)
+        self._index.clear()
+        self._hash_of_page.clear()
+        for p in self._lru:
+            self._free.append(p)
+        self._lru.clear()
+        _cached_pages.set(0)
 
     # -- allocator -----------------------------------------------------------
     @property
     def free_pages(self):
-        return len(self._free)
+        """Pages an ``alloc`` could hand out right now: the plain free
+        list plus the rc=0 indexed pages eviction would reclaim."""
+        return len(self._free) + len(self._lru)
 
     @property
     def used_pages(self):
+        """Pages referenced by at least one live page table (rc >= 1)."""
         return self._used
+
+    @property
+    def cached_pages(self):
+        """rc=0 pages retained for prefix reuse (evictable)."""
+        return len(self._lru)
+
+    @property
+    def shared_pages(self):
+        """Pages live in two or more page tables right now."""
+        return self._shared
 
     def pages_for(self, tokens):
         """Pages a ``tokens``-long sequence reserves (ceil)."""
         return -(-int(tokens) // self.page_size)
 
     def alloc(self, n):
-        """Reserve ``n`` pages; returns their ids or None when the pool
-        can't cover the reservation (the caller queues the sequence)."""
+        """Reserve ``n`` fresh rc=1 pages; returns their ids or None when
+        the pool can't cover the reservation (the caller queues the
+        sequence).  The plain free list is consumed first; only then are
+        least-recently-used rc=0 prefix pages evicted (index entries
+        dropped, ``kv_evictions`` counted)."""
         n = int(n)
-        if n > len(self._free):
+        if n > self.free_pages:
             return None
-        pages = [self._free.popleft() for _ in range(n)]
+        pages = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.popleft()
+            else:
+                p, _ = self._lru.popitem(last=False)  # least recently used
+                h = self._hash_of_page.pop(p)
+                del self._index[h]
+                self._evicted += 1
+                _evictions.inc()
+            self._rc[p] = 1
+            pages.append(p)
         self._used += n
+        _cached_pages.set(len(self._lru))
         return pages
 
     def free(self, pages):
-        """Return a retired sequence's reservation to the free list."""
+        """Drop one reference per page of a retired sequence's
+        reservation.  A page at rc=0 returns to the free list — unless
+        its content is indexed for prefix reuse, in which case it parks
+        in the LRU (most-recently-used end) and keeps answering hits
+        until evicted."""
+        dropped_shared = 0
         for p in pages:
             if p == 0:
                 raise ServingError("page 0 is the scratch page; never owned")
-            self._free.append(p)
-        self._used -= len(pages)
+            rc = self._rc[p]
+            if rc < 1:
+                raise ServingError("double free of page %d" % p)
+            if rc == 2:
+                dropped_shared += 1
+                self._shared -= 1
+            self._rc[p] = rc - 1
+            if rc == 1:
+                self._used -= 1
+                if p in self._hash_of_page:
+                    # fresh insertion lands at the MRU end (a page is
+                    # never already parked while rc >= 1)
+                    self._lru[p] = None
+                else:
+                    self._free.append(p)
+        if dropped_shared:
+            _shared_pages.set(self.shared_pages)
+        _cached_pages.set(len(self._lru))
+
+    # -- prefix cache --------------------------------------------------------
+    @staticmethod
+    def _chain_hashes(tokens, page_size):
+        """Chain hash per FULL page of ``tokens``: link i certifies token
+        blocks ``0 .. i`` (each digest folds in the previous), so an
+        index hit on link i proves the whole prefix matches."""
+        toks = np.ascontiguousarray(np.asarray(tokens, dtype=np.int32))
+        hashes = []
+        h = b"kv-prefix-v1"
+        for i in range(len(toks) // page_size):
+            block = toks[i * page_size:(i + 1) * page_size]
+            h = hashlib.sha1(h + block.tobytes()).digest()
+            hashes.append(h)
+        return hashes
+
+    def prefix_hashes(self, tokens):
+        """Public wrapper: one chain hash per full page of ``tokens``."""
+        return self._chain_hashes(tokens, self.page_size)
+
+    def lookup_prefix(self, tokens):
+        """Probe the index for ``tokens``' longest cached page prefix.
+
+        Returns ``(pages, hashes)``: ``hashes`` is the full chain (one
+        per full page — pass it back to :meth:`register_prefix` as pages
+        get written), ``pages`` the already-cached leading run, each
+        INCREF'd (map them read-only; ``free`` drops the references at
+        retirement).  Reuse is capped at ``len(tokens) - 1`` so at least
+        one token always goes through prefill — the model's last-position
+        logits (the first sampled token) exist in no cache.
+        """
+        ps = self.page_size
+        hashes = self._chain_hashes(tokens, ps)
+        reusable = (len(tokens) - 1) // ps
+        pages = []
+        for i in range(min(reusable, len(hashes))):
+            p = self._index.get(hashes[i])
+            if p is None:
+                break
+            pages.append(p)
+        for p in pages:
+            if self._rc[p] == 0:       # parked in the LRU: revive
+                del self._lru[p]
+                self._used += 1
+            elif self._rc[p] == 1:     # 1 -> 2: newly shared
+                self._shared += 1
+            self._rc[p] += 1
+        misses = max(0, min(reusable, len(hashes)) - len(pages))
+        self._hits += len(pages)
+        self._misses += misses
+        _hit_pages.inc(len(pages))
+        _miss_pages.inc(misses)
+        _shared_pages.set(self.shared_pages)
+        _cached_pages.set(len(self._lru))
+        return pages, hashes
+
+    def release_prefix(self, pages):
+        """Undo a :meth:`lookup_prefix` whose admission could not finish
+        (pool exhausted for the tail): drop the probe's references."""
+        self.free(pages)
+
+    def register_prefix(self, hashes, page_index, page):
+        """Publish one freshly WRITTEN full page: ``page`` holds the K/V
+        of token block ``page_index`` under chain hash
+        ``hashes[page_index]``.  First writer wins — a hash already
+        indexed (a concurrent identical prompt) keeps its existing page
+        and this one stays private."""
+        h = hashes[page_index]
+        if h in self._index or page in self._hash_of_page:
+            return False
+        self._index[h] = page
+        self._hash_of_page[page] = h
+        return True
+
+    def prefix_stats(self):
+        """Per-INSTANCE snapshot (the registry counters sum across every
+        cache in the process; these don't)."""
+        return {
+            "kv_hit_pages": self._hits,
+            "kv_miss_pages": self._misses,
+            "kv_evictions": self._evicted,
+            "kv_shared_pages": self.shared_pages,
+            "kv_cached_pages": len(self._lru),
+            "indexed_pages": len(self._index),
+        }
 
     # -- telemetry -----------------------------------------------------------
     def _publish(self, live_tokens):
@@ -169,8 +356,12 @@ class PagedKVCache:
         _occupancy.set(self._used / usable if usable else 0.0)
         cap = self._used * self.page_size
         # internal fragmentation: reserved-but-unwritten fraction of the
-        # allocated capacity (allocate-on-admit's rent)
-        _fragmentation.set(1.0 - live_tokens / cap if cap else 0.0)
+        # allocated capacity (allocate-on-admit's rent).  Clamped at 0:
+        # shared prefix pages count once in cap but once per OWNER in
+        # the scheduler's live-token sum, so sharing can push the naive
+        # ratio negative
+        _fragmentation.set(max(0.0, 1.0 - live_tokens / cap) if cap
+                           else 0.0)
 
     def publish_gauges(self, live_tokens):
         """Refresh occupancy/fragmentation gauges; the scheduler calls this
@@ -179,7 +370,7 @@ class PagedKVCache:
 
     def fragmentation(self, live_tokens):
         cap = self._used * self.page_size
-        return 1.0 - int(live_tokens) / cap if cap else 0.0
+        return max(0.0, 1.0 - int(live_tokens) / cap) if cap else 0.0
 
     def occupancy(self):
         usable = self.num_pages - 1

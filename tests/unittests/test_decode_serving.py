@@ -358,6 +358,221 @@ class TestSampling:
         assert g.shape == a.shape
 
 
+# -- chunked prefill (ISSUE 15a) ---------------------------------------------
+
+class TestChunkedPrefill:
+    def test_chunked_equals_monolithic_bitwise(self, decode_model):
+        rng = np.random.RandomState(21)
+        prompts = _prompts(8, rng, lo=2, hi=50)
+        outs = {}
+        for name, kw in (("monolithic", {}),
+                         ("chunked", {"prefill_chunk_tokens": 8})):
+            sched = serving.DecodeScheduler(decode_model, _cfg(**kw))
+            futs = [sched.submit(p) for p in prompts]
+            outs[name] = [f.result(timeout=120) for f in futs]
+            assert sched.stats()["kv_pages_used"] == 0
+            sched.stop()
+        for i, (a, b) in enumerate(zip(outs["monolithic"], outs["chunked"])):
+            assert a.tobytes() == b.tobytes(), (
+                "sequence %d differs chunked vs monolithic" % i)
+
+    def test_no_recompiles_with_chunking(self, decode_model):
+        sched = serving.DecodeScheduler(
+            decode_model, _cfg(prefill_chunk_tokens=16))
+        rng = np.random.RandomState(22)
+        c0 = compile_count()
+        futs = [sched.submit(p) for p in _prompts(6, rng, hi=40)]
+        for f in futs:
+            f.result(timeout=120)
+        assert compile_count() == c0, "chunked prefill recompiled"
+        sched.stop()
+
+    def test_config_validation(self, decode_model):
+        with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+            serving.DecodeConfig(page_size=8, prefill_chunk_tokens=12)
+        with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+            serving.DecodeConfig(page_size=8, prefill_chunk_tokens=4)
+        # chunking / prefix caching need a chunk-capable model
+        legacy = serving.DecodeModel(
+            decode_model.prefill_fn, decode_model.decode_fn,
+            num_layers=decode_model.num_layers,
+            num_heads=decode_model.num_heads,
+            head_dim=decode_model.head_dim,
+            vocab_size=decode_model.vocab_size)
+        with pytest.raises(serving.ServingError, match="prefill_chunk_fn"):
+            serving.DecodeScheduler(
+                legacy, _cfg(prefill_chunk_tokens=8, warmup=False),
+                autostart=False)
+        with pytest.raises(serving.ServingError, match="prefill_chunk_fn"):
+            serving.DecodeScheduler(
+                legacy, _cfg(prefix_cache=True, warmup=False),
+                autostart=False)
+
+    def test_legacy_model_without_chunk_fn_still_serves(self, decode_model):
+        legacy = serving.DecodeModel(
+            decode_model.prefill_fn, decode_model.decode_fn,
+            num_layers=decode_model.num_layers,
+            num_heads=decode_model.num_heads,
+            head_dim=decode_model.head_dim,
+            vocab_size=decode_model.vocab_size)
+        sched = serving.DecodeScheduler(legacy, _cfg())
+        out = sched.generate(np.array([4, 5, 6], np.int32),
+                             max_new_tokens=3, timeout=120)
+        sched.stop()
+        assert out.shape == (3,)
+
+    def test_mid_prefill_deadline_shed(self, decode_model):
+        from paddle_tpu.testing import faults
+
+        sched = serving.DecodeScheduler(
+            decode_model, _cfg(prefill_chunk_tokens=8), autostart=False)
+        mid0 = obs.counter("serving.decode.expired_mid_prefill").value
+        with faults.slow_execute(0.01):
+            doomed = sched.submit(np.arange(1, 49, dtype=np.int32),
+                                  max_new_tokens=8, deadline_ms=25)
+            sched.start()
+            deadline = time.perf_counter() + 30
+            while (obs.counter(
+                    "serving.decode.expired_mid_prefill").value <= mid0
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)
+            with pytest.raises(serving.ServingTimeout, match="mid-prefill"):
+                doomed.result(timeout=120)
+        assert obs.counter("serving.decode.expired_mid_prefill").value \
+            == mid0 + 1
+        assert sched.stats()["kv_pages_used"] == 0
+        # still serves after the shed
+        assert sched.generate(np.array([1, 2], np.int32), max_new_tokens=2,
+                              timeout=120).shape == (2,)
+        sched.stop()
+
+    def test_stats_report_chunk_config(self, decode_model):
+        sched = serving.DecodeScheduler(
+            decode_model,
+            _cfg(prefill_chunk_tokens=16, prefix_cache=True, warmup=False),
+            autostart=False)
+        st = sched.stats()
+        assert st["prefill_chunk_tokens"] == 16
+        assert st["prefix_cache"] is True
+        assert "kv_hit_pages" in st["prefix"]
+        sched.stop()
+
+
+# -- prefix caching (ISSUE 15b) ----------------------------------------------
+
+class TestPrefixCache:
+    def test_warm_equals_cold_bitwise_with_hits(self, decode_model):
+        rng = np.random.RandomState(31)
+        prefix = rng.randint(1, 50, size=24).astype(np.int32)
+        prompts = [np.concatenate([prefix,
+                                   rng.randint(1, 50, size=4)
+                                   .astype(np.int32)]) for _ in range(5)]
+        hit = obs.counter("serving.decode.kv_hit_pages")
+        pt = obs.counter("serving.decode.prefill_tokens")
+        outs = {}
+        for name, kw in (("cold", {}), ("warm", {"prefix_cache": True})):
+            sched = serving.DecodeScheduler(decode_model, _cfg(**kw))
+            h0, p0 = hit.value, pt.value
+            outs[name] = [sched.generate(p, timeout=120) for p in prompts]
+            assert sched.stats()["kv_pages_used"] == 0
+            if name == "warm":
+                assert hit.value - h0 > 0, "no page hits on shared prefix"
+                warm_tokens = pt.value - p0
+            else:
+                cold_tokens = pt.value - p0
+            sched.stop()
+        for a, b in zip(outs["cold"], outs["warm"]):
+            assert a.tobytes() == b.tobytes()
+        assert warm_tokens < cold_tokens
+
+    def test_last_token_always_prefills(self, decode_model):
+        # a fully page-aligned, fully cached prompt still prefills >= 1
+        # token: the first sampled token's logits exist in no cache
+        pt = obs.counter("serving.decode.prefill_tokens")
+        sched = serving.DecodeScheduler(decode_model,
+                                        _cfg(prefix_cache=True))
+        prompt = np.arange(1, 17, dtype=np.int32)  # exactly 2 pages
+        sched.generate(prompt, max_new_tokens=2, timeout=120)
+        p0 = pt.value
+        out = sched.generate(prompt, max_new_tokens=2, timeout=120)
+        assert out.shape == (2,)
+        # second run reuses page 0 but must re-run the LAST page (the
+        # reuse cap is len(prompt) - 1 tokens)
+        assert pt.value - p0 == 8
+        sched.stop()
+
+    def test_eviction_under_pressure_serves_correctly(self, decode_model):
+        rng = np.random.RandomState(33)
+        prompts = _prompts(5, rng, lo=30, hi=40)
+        ev = obs.counter("serving.decode.kv_evictions")
+        e0 = ev.value
+        small = _cfg(prefix_cache=True, num_pages=12)
+        sched = serving.DecodeScheduler(decode_model, small)
+        got = [sched.generate(p, timeout=120) for p in prompts]
+        assert sched.stats()["kv_pages_used"] == 0
+        sched.stop()
+        assert ev.value - e0 > 0, "undersized pool never evicted"
+        ref = serving.DecodeScheduler(decode_model, _cfg())
+        want = [ref.generate(p, timeout=120) for p in prompts]
+        ref.stop()
+        for a, b in zip(got, want):
+            assert a.tobytes() == b.tobytes()
+
+    def test_parked_hol_probes_once(self, decode_model):
+        # a head-of-line request parked on pool exhaustion carries its
+        # prefix-probe result (pages pinned) instead of re-probing every
+        # iteration — the hit/miss counters must move ONCE per admission
+        miss = obs.counter("serving.decode.kv_miss_pages")
+        cfg = _cfg(prefix_cache=True, num_pages=8, num_slots=2)
+        sched = serving.DecodeScheduler(decode_model, cfg)
+        m0 = miss.value
+        # A reserves 6 of the 7 usable pages and decodes for many
+        # iterations; B (4 pages) parks behind it the whole time
+        a = sched.submit(np.arange(1, 17, dtype=np.int32),
+                         max_new_tokens=32)
+        b = sched.submit(np.arange(30, 47, dtype=np.int32),  # disjoint
+                         max_new_tokens=8)
+        a.result(timeout=120)
+        b.result(timeout=120)
+        sched.stop()
+        # one probe each: A misses (16-1)//8 = 1 page, B (17-1)//8 = 2
+        assert miss.value - m0 == 3, (
+            "parked HOL request re-probed the prefix index (misses "
+            "counted %d, expected 3)" % (miss.value - m0))
+
+    def test_kv_cache_prefix_unit(self):
+        c = serving.PagedKVCache(1, num_pages=9, page_size=4, num_heads=2,
+                                 head_dim=8, max_seq_len=32)
+        toks = np.arange(100, 113, dtype=np.int32)  # 13 tokens: 3 full pages
+        pages, hashes = c.lookup_prefix(toks)
+        assert pages == [] and len(hashes) == 3
+        owned = c.alloc(4)
+        for i in range(3):
+            assert c.register_prefix(hashes, i, owned[i])
+        # duplicate registration (another writer) is refused
+        assert not c.register_prefix(hashes, 0, owned[3])
+        c.free(owned)
+        assert c.used_pages == 0 and c.cached_pages == 3
+        # a second identical prompt hits the whole reusable prefix
+        # (capped at len - 1 = 12 tokens = 3 pages)
+        pages2, _ = c.lookup_prefix(toks)
+        assert pages2 == owned[:3] and c.used_pages == 3
+        # a prompt that diverges at page 1 reuses only page 0
+        toks3 = toks.copy()
+        toks3[5] = 999
+        c.free(pages2)
+        pages3, _ = c.lookup_prefix(toks3)
+        assert pages3 == owned[:1]
+        c.free(pages3)
+        # pressure: allocating everything evicts the LRU parked pages
+        ev0 = obs.counter("serving.decode.kv_evictions").value
+        big = c.alloc(8)
+        assert len(big) == 8
+        assert obs.counter("serving.decode.kv_evictions").value - ev0 == 3
+        assert c.lookup_prefix(toks)[0] == []  # index flushed by eviction
+        c.free(big)
+
+
 # -- prefill retry (the replayable decode leg) -------------------------------
 
 class TestPrefillRetry:

@@ -29,6 +29,31 @@ Scenario 4 — throughput smoke:
   an open-loop mixed prefill+decode load, bitwise per-sequence equality
   and the zero-recompile assert enforced inside the bench.
 
+Scenario 5 — chunked prefill (ISSUE 15a):
+  the same prompts through chunked (prefill_chunk_tokens) and monolithic
+  prefill must return bitwise-identical tokens with ZERO recompiles
+  after warmup and the KV pool fully returned, on BOTH attention
+  engines (the CPU reference and the pallas kernel under interpret);
+  a deadline that passes mid-prefill sheds between chunks with
+  ServingTimeout, counts serving.decode.expired_mid_prefill, and
+  reports time-in-queue vs time-in-prefill.
+
+Scenario 6 — prefix cache (ISSUE 15b):
+  a warm prefix cache must return bitwise-identical tokens to a cold
+  one while prefilling >= 50% fewer prompt tokens on a shared-prefix
+  workload (serving.decode.kv_hit_pages / prefill_tokens observable);
+  refcounts return to zero after retirement (kv_pages_used == 0,
+  kv_shared_pages == 0); and a pool too small to hold the working set
+  still serves bitwise-correctly while evicting LRU refcount-zero
+  pages (serving.decode.kv_evictions > 0).
+
+Scenario 7 — head-of-line + repeated-prefix smoke:
+  bench_decode.py --long-prompts --smoke (>= 3x better short-prompt p95
+  TTFT under a mixed long/short open-loop burst at no tokens/s
+  regression) and --repeated-prefix --smoke (>= 50% prefill-token
+  reduction, >= 50% page hit rate) in subprocesses, bitwise equality
+  enforced inside each.
+
 Runnable locally:
     python tools/check_decode.py
 and wired into the tier-1 flow via tests/unittests/test_decode_gate.py.
@@ -51,13 +76,14 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never touch a TPU from CI
 import numpy as np  # noqa: E402
 
 
-def _model(vocab=60, eos_id=None):
+def _model(vocab=60, eos_id=None, attn_impl=None):
     from paddle_tpu.models import transformer as T
 
     params, meta = T.lm_params(seed=31, vocab_size=vocab, n_layer=2,
                                n_head=2, d_model=32, d_inner=64,
                                max_length=128)
-    return T.build_decode_model(params, meta, eos_id=eos_id)
+    return T.build_decode_model(params, meta, eos_id=eos_id,
+                                attn_impl=attn_impl)
 
 
 def _cfg(**kw):
@@ -202,20 +228,30 @@ def scenario_telemetry_schema():
             % (len(prompts), n_tokens, d["steps"]))
 
 
-def scenario_throughput_smoke():
+def _bench_smoke(flag=None):
+    """Run benchmarks/bench_decode.py [flag] --smoke in a clean CPU
+    subprocess and return its parsed JSON report — ONE launcher for
+    every bench-backed scenario so env/timeout/parsing can't diverge."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_PLATFORM_NAME"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "benchmarks", "bench_decode.py"),
-         "--smoke"],
-        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    args = [sys.executable,
+            os.path.join(REPO, "benchmarks", "bench_decode.py")]
+    if flag:
+        args.append(flag)
+    args.append("--smoke")
+    proc = subprocess.run(args, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=600)
     assert proc.returncode == 0, (
-        "bench_decode.py --smoke failed (rc=%d):\n%s\n%s"
-        % (proc.returncode, proc.stdout, proc.stderr))
-    payload = proc.stdout[proc.stdout.index("{"):]
-    report = json.loads(payload)["decode"]
+        "bench_decode.py %s--smoke failed (rc=%d):\n%s\n%s"
+        % ((flag + " ") if flag else "", proc.returncode, proc.stdout,
+           proc.stderr))
+    return json.loads(proc.stdout[proc.stdout.index("{"):])
+
+
+def scenario_throughput_smoke():
+    report = _bench_smoke()["decode"]
     assert report["bitwise_equal"]
     assert report["continuous"]["compiles_during_serve"] == 0
     assert report["continuous_batching_speedup"] >= 2.0, report
@@ -228,12 +264,181 @@ def scenario_throughput_smoke():
                report["continuous"]["p95_ttft_ms"]))
 
 
+def scenario_chunked_prefill():
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.executor import compile_count
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 60, size=rng.randint(2, 50)).astype(np.int32)
+               for _ in range(10)]
+    # both attention engines: the CPU reference formulation and the TPU
+    # pallas kernel run under interpret
+    for impl in (None, "pallas"):
+        model = _model(attn_impl=impl)
+        n = len(prompts) if impl is None else 4
+        results = {}
+        for name, kw in (("monolithic", {}),
+                         ("chunked", {"prefill_chunk_tokens": 8})):
+            sched = serving.DecodeScheduler(model, _cfg(**kw))
+            c0 = compile_count()
+            futs = [sched.submit(p) for p in prompts[:n]]
+            results[name] = [f.result(timeout=300) for f in futs]
+            d = compile_count() - c0
+            assert d == 0, ("%s/%s leg recompiled %d times after warmup"
+                            % (name, impl, d))
+            st = sched.stats()
+            assert st["kv_pages_used"] == 0, (
+                "%s leg leaked %d KV pages" % (name, st["kv_pages_used"]))
+            sched.stop()
+        bad = [i for i in range(n)
+               if results["chunked"][i].tobytes()
+               != results["monolithic"][i].tobytes()]
+        assert not bad, (
+            "%d/%d sequences differ chunked vs monolithic (impl=%s, "
+            "first: %d)" % (len(bad), n, impl, bad[0]))
+    # mid-prefill deadline shed: a doomed long prompt frees its budget
+    # BETWEEN chunks, counts expired_mid_prefill, and its error reports
+    # time-in-queue vs time-in-prefill
+    from paddle_tpu.testing import faults
+
+    model = _model()
+    sched = serving.DecodeScheduler(
+        model, _cfg(prefill_chunk_tokens=8), autostart=False)
+    mid0 = obs.counter("serving.decode.expired_mid_prefill").value
+    with faults.slow_execute(0.01):  # each chunk >= 10ms: 7 chunks > 30ms
+        doomed = sched.submit(
+            np.arange(1, 50, dtype=np.int32).repeat(2)[:50],
+            max_new_tokens=8, deadline_ms=30)
+        sched.start()
+        # wait for the WORKER's shed (the future's own deadline check
+        # races it and would win with a generic "unanswered" timeout)
+        deadline = time.perf_counter() + 30
+        while (obs.counter("serving.decode.expired_mid_prefill").value
+               <= mid0 and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        try:
+            doomed.result(timeout=300)
+        except serving.ServingTimeout as e:
+            assert "mid-prefill" in str(e) and "in queue" in str(e), e
+        else:
+            raise AssertionError("mid-prefill deadline was not shed")
+    assert obs.counter("serving.decode.expired_mid_prefill").value \
+        == mid0 + 1
+    st = sched.stats()
+    assert st["kv_pages_used"] == 0, "mid-prefill shed leaked pages"
+    # the scheduler still serves after the shed
+    out = sched.generate(np.array([3, 4, 5], np.int32), max_new_tokens=2,
+                         timeout=300)
+    sched.stop()
+    assert out.shape == (2,)
+    return ("chunked prefill: bitwise == monolithic on both engines, 0 "
+            "recompiles, 0 leaks, mid-prefill shed counted OK")
+
+
+def scenario_prefix_cache():
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+
+    model = _model()
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(1, 60, size=32).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.randint(1, 60, size=6)
+                               .astype(np.int32)]) for _ in range(6)]
+    prefill_tokens = obs.counter("serving.decode.prefill_tokens")
+    hit_pages = obs.counter("serving.decode.kv_hit_pages")
+    outs = {}
+    for name, kw in (("cold", {}), ("warm", {"prefix_cache": True})):
+        sched = serving.DecodeScheduler(model, _cfg(**kw))
+        p0, h0 = prefill_tokens.value, hit_pages.value
+        outs[name] = [sched.generate(p, timeout=300) for p in prompts]
+        st = sched.stats()
+        assert st["kv_pages_used"] == 0, (
+            "%s leg left %d pages referenced after retirement"
+            % (name, st["kv_pages_used"]))
+        shared = obs.gauge("serving.decode.kv_shared_pages").value or 0
+        assert shared == 0, (
+            "%s leg left %d shared pages after retirement" % (name, shared))
+        if name == "warm":
+            warm_prefilled = prefill_tokens.value - p0
+            warm_hits = hit_pages.value - h0
+        else:
+            cold_prefilled = prefill_tokens.value - p0
+        sched.stop()
+    bad = [i for i in range(len(prompts))
+           if outs["warm"][i].tobytes() != outs["cold"][i].tobytes()]
+    assert not bad, ("%d/%d sequences differ warm vs cold prefix cache"
+                     % (len(bad), len(prompts)))
+    assert warm_hits > 0, "shared-prefix workload produced no page hits"
+    reduction = 1.0 - warm_prefilled / cold_prefilled
+    assert reduction >= 0.5, (
+        "prefix cache avoided only %.0f%% of prefill tokens (%d -> %d)"
+        % (reduction * 100, cold_prefilled, warm_prefilled))
+    # eviction under pressure: a pool too small for the distinct-prompt
+    # working set must evict LRU refcount-zero pages and STILL serve
+    # bitwise-correctly
+    ev0 = obs.counter("serving.decode.kv_evictions").value
+    distinct = [rng.randint(1, 60, size=40).astype(np.int32)
+                for _ in range(6)]
+    small = _cfg(prefix_cache=True, num_pages=13)  # 12 usable pages
+    sched = serving.DecodeScheduler(model, small)
+    got = [sched.generate(p, timeout=300) for p in distinct]
+    assert sched.stats()["kv_pages_used"] == 0
+    sched.stop()
+    evictions = obs.counter("serving.decode.kv_evictions").value - ev0
+    assert evictions > 0, (
+        "undersized pool (12 pages, 6x6-page seqs) never evicted")
+    ref = serving.DecodeScheduler(model, _cfg())
+    want = [ref.generate(p, timeout=300) for p in distinct]
+    ref.stop()
+    bad = [i for i in range(len(distinct))
+           if got[i].tobytes() != want[i].tobytes()]
+    assert not bad, ("%d/%d sequences differ under eviction pressure"
+                     % (len(bad), len(distinct)))
+    return ("prefix cache: warm bitwise == cold with %.0f%% fewer "
+            "prefill tokens (%d page hits), refcounts drained, %d "
+            "evictions served correctly OK"
+            % (reduction * 100, warm_hits, evictions))
+
+
+def scenario_long_prompt_smoke():
+    report = _bench_smoke("--long-prompts")["decode_long_prompts"]
+    assert report["bitwise_equal"]
+    assert report["chunked"]["compiles_during_serve"] == 0
+    assert report["p95_short_ttft_gain"] >= 3.0, report
+    assert report["tokens_per_s_ratio"] >= 0.9, report
+    return ("head-of-line: short-prompt p95 TTFT %.0f -> %.0fms "
+            "(%.1fx >= 3x) at %.2fx tokens/s, bitwise OK"
+            % (report["monolithic"]["p95_short_ttft_ms"],
+               report["chunked"]["p95_short_ttft_ms"],
+               report["p95_short_ttft_gain"],
+               report["tokens_per_s_ratio"]))
+
+
+def scenario_repeated_prefix_smoke():
+    report = _bench_smoke("--repeated-prefix")["decode_repeated_prefix"]
+    assert report["bitwise_equal"]
+    assert report["warm"]["compiles_during_serve"] == 0
+    assert report["prefill_token_reduction"] >= 0.5, report
+    assert report["warm"]["hit_rate"] >= 0.5, report
+    return ("repeated prefix: %d -> %d prefill tokens (%.0f%% avoided "
+            ">= 50%%), hit rate %.0f%%, bitwise warm == cold OK"
+            % (report["cold"]["prefill_tokens"],
+               report["warm"]["prefill_tokens"],
+               report["prefill_token_reduction"] * 100,
+               report["warm"]["hit_rate"] * 100))
+
+
 def main():
     failures = []
     for scenario in (scenario_bitwise_and_no_recompile,
                      scenario_admission_contracts,
                      scenario_telemetry_schema,
-                     scenario_throughput_smoke):
+                     scenario_throughput_smoke,
+                     scenario_chunked_prefill,
+                     scenario_prefix_cache,
+                     scenario_long_prompt_smoke,
+                     scenario_repeated_prefix_smoke):
         try:
             msg = scenario()
         except AssertionError as e:

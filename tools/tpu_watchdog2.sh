@@ -28,6 +28,10 @@ while true; do
     # inter-token latency, pallas paged-attention path) — PERF.md "Decode
     # throughput" queues this capture
     run_leg /root/repo/DECODE_live.json     1800 python benchmarks/bench_decode.py || all_ok=0
+    # ISSUE 15: chunked prefill + paged-attention on chip — short-prompt
+    # p95 TTFT chunked vs monolithic under the mixed long/short burst
+    # (the pallas paged_prefill_attention path's first live numbers)
+    run_leg /root/repo/DECODE_chunked.json  1800 python benchmarks/bench_decode.py --long-prompts || all_ok=0
     [ $all_ok -eq 1 ] || exit 1
     echo "$(date -u +%H:%M:%S) [wd2] SEQUENCE COMPLETE" >> "$LOG"
     exit 0
