@@ -47,7 +47,14 @@ and transient faults were retried without losing requests.
 Multi-replica serving (``serving.ReplicaPool``) rides the same harness:
 ``--replicas N`` serves every leg from an N-replica pool over forced
 host devices instead of a single engine (same admission surface, so
-nothing else changes), and ``--scaling`` runs the replica-scaling
+nothing else changes), ``--decode`` adds a MIXED leg per arrival
+process — every ``DECODE_EVERY``-th arrival becomes a
+``generate_async`` call riding the pool's durable decode path
+(per-replica ``DecodeScheduler``s behind the shared queue,
+docs/fault_tolerance.md "Decode durability") while the rest stay
+predicts, smoke-asserting zero unresolved futures across BOTH kinds
+and the interactive > best_effort goodput ladder under the mixed
+load — and ``--scaling`` runs the replica-scaling
 ladder — ONE warm 4-replica pool whose ACTIVE rotation is resized
 1 → 2 → 4 between legs (``set_active_replicas``, i.e. the autoscale
 path under live traffic), all legs offered the SAME fixed rate derived
@@ -63,6 +70,7 @@ Usage:
   python benchmarks/bench_load.py --smoke     # quick run + assertions
   python benchmarks/bench_load.py --process bursty --overload 5
   python benchmarks/bench_load.py --replicas 4 --smoke
+  python benchmarks/bench_load.py --replicas 4 --decode --smoke
   python benchmarks/bench_load.py --scaling --smoke
 """
 from __future__ import annotations
@@ -94,6 +102,10 @@ CLASS_MIX = (("interactive", 0.30), ("batch", 0.40), ("best_effort", 0.30))
 # service-rate estimator is warm those arrivals shed AT ADMISSION
 # (ServingOverloaded) instead of being discovered dead at pop time.
 DEADLINE_ROWS = {"interactive": 120, "batch": 240, "best_effort": 120}
+# --decode mixed legs: every Nth arrival is a generation instead of a
+# predict (offset 3 so the first few arrivals warm the predict path)
+DECODE_EVERY = 7
+DECODE_NEW_TOKENS = 6
 
 
 def save_model(dirname):
@@ -117,13 +129,32 @@ def save_model(dirname):
     return dirname
 
 
-def make_engine(model_dir, replicas=1, max_replicas=None):
+def build_decode_model():
+    """Small 2-layer LM for the ``--decode`` mixed legs (same shape the
+    decode gates use: fast to warm, real paged-KV decode path)."""
+    from paddle_tpu.models import transformer as T
+
+    params, meta = T.lm_params(seed=31, vocab_size=60, n_layer=2,
+                               n_head=2, d_model=32, d_inner=64,
+                               max_length=128)
+    return T.build_decode_model(params, meta)
+
+
+def make_engine(model_dir, replicas=1, max_replicas=None, decode=False):
     """One serving frontend: a single engine (``replicas=1``) or an
     N-replica pool — same admission surface, so every leg below is
-    agnostic to which it got."""
+    agnostic to which it got.  ``decode=True`` attaches a decode model
+    so the mixed legs can route ``generate_async`` through the pool."""
     from paddle_tpu import serving
 
-    if replicas == 1 and max_replicas is None:
+    decode_kw = {}
+    if decode:
+        decode_kw = dict(
+            decode_model=build_decode_model(),
+            decode_config=serving.DecodeConfig(
+                num_slots=4, page_size=8, max_seq_len=64,
+                max_new_tokens=DECODE_NEW_TOKENS))
+    if replicas == 1 and max_replicas is None and not decode:
         return serving.InferenceEngine(
             model_dir, batch_buckets=(2, 4, 8, 16), max_batch_size=16,
             batch_timeout_ms=0.0, queue_capacity=QUEUE_CAPACITY,
@@ -137,7 +168,7 @@ def make_engine(model_dir, replicas=1, max_replicas=None):
         batch_timeout_ms=0.0, queue_capacity=QUEUE_CAPACITY,
         class_capacity=CLASS_CAPACITY, backend="program",
         breaker_threshold=8, breaker_cooldown_s=0.5,
-        supervisor_interval_s=0.05)
+        supervisor_interval_s=0.05, **decode_kw)
 
 
 def measure_capacity(engine, seconds=1.0, n_threads=4, depth=8):
@@ -198,9 +229,16 @@ def build_schedule(process, rate, n, seed, capacity):
     return sched
 
 
-def run_open_loop(engine, schedule, seed):
+def run_open_loop(engine, schedule, seed, decode_every=0):
     """Submit the schedule open-loop; resolve everything; per-class
     outcome table.  Returns (per_class dict, overall dict).
+
+    ``decode_every=N``: every Nth arrival becomes a ``generate_async``
+    call (a short generation through the pool's decode schedulers, same
+    priority class, no deadline) instead of a predict — the mixed
+    predict+generate traffic shape a real LM frontend serves.  Generate
+    outcomes are tallied separately under ``overall["generate"]``; the
+    per-class predict table keeps its meaning.
 
     Latency quantiles come from the LIVE telemetry histograms
     (``serving.request_latency_<class>``, snapshotted before/after the
@@ -213,8 +251,12 @@ def run_open_loop(engine, schedule, seed):
 
     rng = np.random.RandomState(seed + 1)
     payloads = [rng.randn(1, WIDTH).astype(np.float32) for _ in range(128)]
+    prompts = [rng.randint(1, 60, size=rng.randint(4, 13)).astype(np.int32)
+               for _ in range(64)]
     outcomes = []   # (cls, kind, latency_s or None, deadline_met)
     futs = []       # (idx, cls, deadline_ms, arrival_ts, fut)
+    gen_futs = []   # generate requests resolve on their own tally
+    gen = {"attempted": 0, "ok": 0, "shed": 0, "failed": 0, "unresolved": 0}
     lateness = []     # exact: not exported anywhere, so no histogram to match
     lat_base = {cls: obs.histogram("serving.request_latency_%s" % cls)
                 .snapshot() for cls, _ in CLASS_MIX}
@@ -226,6 +268,17 @@ def run_open_loop(engine, schedule, seed):
         else:
             lateness.append(now - dt)
         arrival = time.perf_counter()
+        if decode_every and i % decode_every == 3:
+            gen["attempted"] += 1
+            try:
+                gf = engine.generate_async(
+                    prompts[i % 64], max_new_tokens=DECODE_NEW_TOKENS,
+                    priority=cls)
+            except serving.ServingError:
+                gen["shed"] += 1
+            else:
+                gen_futs.append(gf)
+            continue
         try:
             fut = engine.predict_async({"x": payloads[i % 128]},
                                        deadline_ms=deadline_ms,
@@ -239,6 +292,15 @@ def run_open_loop(engine, schedule, seed):
         else:
             futs.append((i, cls, deadline_ms, arrival, fut))
     submit_span = time.perf_counter() - t0
+    for gf in gen_futs:
+        try:
+            toks = gf.result(timeout=120)
+        except serving.ServingError:
+            gen["failed"] += 1   # typed terminal outcome (shed at pop,
+        else:                    # degraded, cancelled...) — not a hang
+            gen["ok"] += 1 if len(toks) else 0
+    gen["unresolved"] = gen["attempted"] - gen["shed"] - gen["failed"] \
+        - gen["ok"]
     unresolved = 0
     for i, cls, deadline_ms, arrival, fut in futs:
         try:
@@ -296,10 +358,13 @@ def run_open_loop(engine, schedule, seed):
             round(float(np.percentile(lateness, 95)) * 1e3, 2)
             if lateness else 0.0),
     }
+    if decode_every:
+        overall["generate"] = gen
     return per_class, overall
 
 
-def run_leg(engine, process, rate, n, seed, capacity, flaky_every=0):
+def run_leg(engine, process, rate, n, seed, capacity, flaky_every=0,
+            decode_every=0):
     from paddle_tpu import observability as obs
     from paddle_tpu.testing import faults
 
@@ -316,21 +381,24 @@ def run_leg(engine, process, rate, n, seed, capacity, flaky_every=0):
             return count[0] % flaky_every == 0
 
         with faults.flaky_execute(times=None, match=every_nth):
-            per_class, overall = run_open_loop(engine, schedule, seed)
+            per_class, overall = run_open_loop(engine, schedule, seed,
+                                               decode_every=decode_every)
     else:
-        per_class, overall = run_open_loop(engine, schedule, seed)
+        per_class, overall = run_open_loop(engine, schedule, seed,
+                                           decode_every=decode_every)
     overall["retries"] = obs.counter("serving.retries").value - r0
     overall["process"] = process
     return {"per_class": per_class, "overall": overall}
 
 
-def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1):
+def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1,
+                   decode=False):
     from paddle_tpu.testing import faults
 
     td = tempfile.mkdtemp()
     model_dir = save_model(os.path.join(td, "model"))
     legs = {}
-    engine = make_engine(model_dir, replicas=replicas)
+    engine = make_engine(model_dir, replicas=replicas, decode=decode)
     old_switch = sys.getswitchinterval()
     sys.setswitchinterval(0.001)
     try:
@@ -345,6 +413,11 @@ def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1):
                 for proc in processes:
                     legs[proc] = run_leg(engine, proc, rate, n_requests,
                                          seed + attempt, capacity)
+                    if decode:
+                        legs["%s_decode" % proc] = run_leg(
+                            engine, proc, rate, n_requests,
+                            seed + attempt + 13, capacity,
+                            decode_every=DECODE_EVERY)
                 legs["%s_faulty" % processes[0]] = run_leg(
                     engine, processes[0], rate, n_requests,
                     seed + attempt + 7, capacity, flaky_every=7)
@@ -358,6 +431,7 @@ def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1):
         "model": "mlp 2x%d + %.0fms service shim" % (WIDTH,
                                                      SERVICE_DELAY_S * 1e3),
         "replicas": replicas,
+        "decode": decode,
         "capacity_req_s": round(capacity, 1),
         "overload_factor": overload,
         "offered_rate_req_s": round(rate, 1),
@@ -457,6 +531,15 @@ def _assert_smoke(report):
         # (no hangs) every admitted request reached a terminal outcome
         assert ov["unresolved"] == 0, (name, ov)
         resolved = sum(pc[c]["attempted"] for c in pc)
+        gen = ov.get("generate")
+        if gen is not None:
+            # the mixed leg: every generation ALSO reached a terminal
+            # outcome (admitted ones completed or failed typed — the
+            # durable-decode no-hang contract), some really decoded,
+            # and the predict ladder below still holds under the mix
+            assert gen["unresolved"] == 0, (name, gen)
+            assert gen["attempted"] > 0 and gen["ok"] > 0, (name, gen)
+            resolved += gen["attempted"]
         assert resolved == ov["requests"], (name, resolved, ov)
         # the offered load really was overload: something got shed or
         # expired (otherwise the leg proves nothing about SLO behavior)
@@ -507,6 +590,10 @@ def main(argv=None):
     parser.add_argument("--replicas", type=int, default=1,
                         help="serve the legs from a ReplicaPool of N "
                              "device-pinned replicas (1 = single engine)")
+    parser.add_argument("--decode", action="store_true",
+                        help="add a mixed predict+generate leg per "
+                             "arrival process: every %dth arrival rides "
+                             "the pool's decode schedulers" % DECODE_EVERY)
     parser.add_argument("--scaling", action="store_true",
                         help="replica-scaling ladder: one warm pool, "
                              "rotation resized %s, fixed offered rate"
@@ -528,7 +615,8 @@ def main(argv=None):
         n = args.requests or (600 if args.smoke else 2400)
         results["load"] = run_load_bench(args.smoke, args.process,
                                          args.overload or 3.0, n, args.seed,
-                                         replicas=args.replicas)
+                                         replicas=args.replicas,
+                                         decode=args.decode)
     print(json.dumps(results, indent=2, sort_keys=True))
     return results
 

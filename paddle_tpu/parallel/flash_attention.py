@@ -37,7 +37,7 @@ import jax
 import numpy as np
 
 __all__ = ["flash_attention", "mha_reference", "paged_decode_attention",
-           "paged_prefill_attention"]
+           "paged_prefill_attention", "paged_kv_finite"]
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -1152,3 +1152,31 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, kv_lens,
         interpret = _infer_interpret(q)
     return _paged_pallas(q, k_pool, v_pool, page_tables, kv_lens, sm_scale,
                          interpret)
+
+
+def paged_kv_finite(k_pool, v_pool, pages):
+    """Fused per-page isfinite sweep over freshly written KV pages.
+
+    The decode analog of the trainer's ``nan_guard``: the scheduler runs
+    this (opt-in, ``DecodeConfig(kv_guard=True)``) over the pages a
+    prefill chunk or decode step just wrote, so a non-finite k/v
+    projection fails exactly the owning sequence typed instead of
+    parking NaNs in pages a prefix-sharing sequence will read later.
+
+    k_pool / v_pool: the cache's stacked ``[L, num_pages, ps, H, D]``
+    pools (all layers — a bad write in ANY layer must trip).
+    pages: ``[N]`` int32 page ids to check (per-slot decode tail pages,
+    or the pages a chunk wrote; padding entries may aim at scratch
+    page 0, whose writes are always finite model outputs).
+
+    Returns ``[N]`` bool — ``False`` marks a page holding a non-finite
+    value.  One gather + one reduction, fused under the caller's jit;
+    everything reduces on device and only N booleans cross to host.
+    """
+    import jax.numpy as jnp
+
+    k = k_pool[:, pages]        # [L, N, ps, H, D]
+    v = v_pool[:, pages]
+    axes = (0, 2, 3, 4)
+    return (jnp.isfinite(k).all(axis=axes)
+            & jnp.isfinite(v).all(axis=axes))

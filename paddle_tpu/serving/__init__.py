@@ -64,27 +64,49 @@ per-class capacity) and deadlines shed AT ADMISSION with
 ``ServingOverloaded`` once the measured service rate says they can't be
 met; PREDICT dispatch faults are retried (transient), bisected
 (poison), and circuit-breaker-counted (persistent, ``ServingDegraded``
-fast-fail + half-open recovery) — decode dispatch faults fail their
-active sequences typed without retry/bisection (iteration state is not
-replayable; see docs/serving.md); a dead worker thread (either path)
-is restarted by the supervisor — an admitted request ALWAYS reaches a
-terminal outcome.
+fast-fail + half-open recovery); DECODE dispatch faults retry
+transients in place (``DecodeConfig.decode_retries`` — the paged-pool
+updates are functional, so a failed attempt left the buffers intact)
+and fail typed past the budget or on a fatal fault; a dead worker
+thread (either path) is restarted by the supervisor — an admitted
+request ALWAYS reaches a terminal outcome.
+
+Decode is DURABLE under a ``ReplicaPool`` (docs/fault_tolerance.md
+"Decode durability"): ``ReplicaPool(..., decode_model=...)`` runs one
+``DecodeScheduler`` per replica behind a shared queue
+(least-loaded-by-free-slots claim dispatch), and every request's
+``DecodeJournal`` (prompt + pinned sampling knobs + accepted tokens;
+O(tokens) host memory) makes its state portable: a replica death
+evicts its in-flight sequences and REPLAYS them on siblings —
+re-prefilling ``prompt + accepted``, bitwise-identical continuation
+via absolute-position PRNG folding — bounded by
+``DecodeConfig.replay_budget``.  ``GenerateRequest.cancel()`` retires
+an abandoned generation at the next iteration boundary
+(``ServingCancelled``), and the opt-in ``DecodeConfig(kv_guard=True)``
+isfinite sweep fails exactly the sequence that wrote a non-finite KV
+page (``KVCorruption``, pages scrubbed) instead of letting it poison
+shared prefix pages.
 ``testing.faults.flaky_execute``/``slow_execute``/``poison_request``/
-``kill_worker`` inject each failure deterministically, and
-``benchmarks/bench_load.py`` + ``tools/check_slo.py`` gate
-goodput-under-deadline per class against open-loop overload.
+``kill_worker``/``kill_replica_mid_decode``/``corrupt_kv_page`` inject
+each failure deterministically; ``benchmarks/bench_load.py`` +
+``tools/check_slo.py`` gate goodput-under-deadline per class against
+open-loop overload, and ``tools/check_decode_resilience.py`` gates the
+kill-mid-decode bitwise-replay contract.
 """
 from __future__ import annotations
 
 from .batcher import CompletionTracker, DynamicBatcher
 from .decode_scheduler import (
     DecodeConfig,
+    DecodeJournal,
     DecodeModel,
     DecodeScheduler,
     GenerateRequest,
 )
 from .engine import BatchExecutor, InferenceEngine
 from .errors import (
+    KVCorruption,
+    ServingCancelled,
     ServingClosed,
     ServingDegraded,
     ServingError,
@@ -115,6 +137,7 @@ __all__ = [
     "DecodeScheduler",
     "DecodeModel",
     "DecodeConfig",
+    "DecodeJournal",
     "GenerateRequest",
     "PagedKVCache",
     "write_prompt_kv",
@@ -125,4 +148,6 @@ __all__ = [
     "ServingOverloaded",
     "ServingDegraded",
     "ServingClosed",
+    "ServingCancelled",
+    "KVCorruption",
 ]

@@ -54,6 +54,24 @@ Admission reuses the serving contracts: bounded queue with typed
 ``ServingQueueFull`` backpressure, per-request deadlines shed with
 ``ServingTimeout`` (in queue AND mid-decode), ``ServingClosed`` after
 stop.  Everything reports as ``serving.decode.*`` telemetry.
+
+**Durability** (ISSUE 17): every request carries a host-side
+:class:`DecodeJournal` — prompt, sampling knobs, and the accepted
+tokens so far, O(tokens) memory and no KV — which makes a sequence's
+full decode state portable: a failed replica's in-flight sequences are
+EVICTED (:meth:`DecodeScheduler.evict_inflight`, pages freed, futures
+untouched) and re-admitted elsewhere by re-prefilling
+``prompt + accepted`` and decoding the remainder.  Because every token
+at absolute position ``i`` is sampled with the same
+``fold_in(PRNGKey(seed), i)`` key whether it came from prefill or
+decode, the resumed output is BITWISE identical to the uninterrupted
+run (gated by tools/check_decode_resilience.py).  Transient
+decode-step faults retry in place (``decode_retries`` — the pools are
+functional, so a failed attempt left them intact), the opt-in
+``kv_guard`` sweeps freshly written pages for non-finite values and
+fails exactly the owning sequence typed (``KVCorruption``) with the
+pages scrubbed, and ``GenerateRequest.cancel()`` retires a sequence at
+the next iteration boundary instead of decoding to max_len for nobody.
 """
 from __future__ import annotations
 
@@ -66,6 +84,8 @@ from .. import observability as _obs
 from .. import resilience as _resilience
 from ..executor import JitStepCache
 from .errors import (
+    KVCorruption,
+    ServingCancelled,
     ServingClosed,
     ServingDegraded,
     ServingError,
@@ -75,8 +95,8 @@ from .kv_cache import PagedKVCache, write_prompt_kv
 from .request_queue import Request, RequestQueue
 from .worker import RestartableWorker
 
-__all__ = ["DecodeModel", "DecodeConfig", "GenerateRequest",
-           "DecodeScheduler"]
+__all__ = ["DecodeModel", "DecodeConfig", "DecodeJournal",
+           "GenerateRequest", "DecodeScheduler"]
 
 _requests = _obs.counter("serving.decode.requests")
 _tokens = _obs.counter("serving.decode.tokens")
@@ -101,6 +121,10 @@ _step_hist = _obs.histogram("serving.decode.step")
 _prefill_retries = _obs.counter("serving.decode.prefill_retries")
 _prefill_tokens = _obs.counter("serving.decode.prefill_tokens")
 _expired_mid_prefill = _obs.counter("serving.decode.expired_mid_prefill")
+_step_retries = _obs.counter("serving.decode.step_retries")
+_cancelled = _obs.counter("serving.decode.cancelled")
+_replays = _obs.counter("serving.decode.replays")
+_kv_guard_trips = _obs.counter("serving.decode.kv_guard_trips")
 
 
 def _sample_token(logits, key, temp, top_k):
@@ -215,6 +239,25 @@ class DecodeConfig:
         refcount-zero pages — see kv_cache.py).  Requires
         ``prefill_chunk_fn`` (a hit resumes prefill mid-prompt).
         Generated tokens are bitwise identical warm vs cold.
+    decode_retries: transient DECODE-step dispatch faults retry this
+        many times before failing the active sequences typed.  The
+        decode step is replayable for the same reason prefill is — the
+        pool updates are functional, a failed attempt leaves the
+        current buffers intact — so forced to 0 under pool donation
+        (TPU), where a failed donated dispatch consumed them.
+    replay_budget: times a sequence may be re-admitted after a replica
+        death before failing typed (``ServingDegraded``).  Replay
+        re-prefills ``prompt + accepted-so-far`` on a sibling and
+        continues bitwise-identically (absolute-position PRNG folding);
+        the budget bounds the work a crash-looping fleet can re-burn
+        per request.
+    kv_guard: opt-in KV integrity sweep — after every prefill chunk and
+        decode step, a fused isfinite reduction over the pages just
+        written.  A non-finite write fails exactly the owning sequence
+        with :class:`~.errors.KVCorruption` and scrubs its pages
+        (zeroed + dropped from the prefix index) instead of silently
+        poisoning shared prefix pages.  Costs one small device
+        reduction + a host sync per step; off by default.
     """
 
     def __init__(self, num_slots=4, page_size=16, max_seq_len=256,
@@ -222,7 +265,8 @@ class DecodeConfig:
                  max_active=None, queue_capacity=128,
                  default_deadline_ms=None, kv_dtype="float32", warmup=True,
                  default_temperature=0.0, top_k=None, prefill_retries=2,
-                 prefill_chunk_tokens=None, prefix_cache=False):
+                 prefill_chunk_tokens=None, prefix_cache=False,
+                 decode_retries=2, replay_budget=2, kv_guard=False):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.max_seq_len = int(max_seq_len)
@@ -241,6 +285,11 @@ class DecodeConfig:
         self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
                                      else int(prefill_chunk_tokens))
         self.prefix_cache = bool(prefix_cache)
+        self.decode_retries = int(decode_retries)
+        self.replay_budget = int(replay_budget)
+        self.kv_guard = bool(kv_guard)
+        if self.decode_retries < 0 or self.replay_budget < 0:
+            raise ValueError("decode_retries and replay_budget must be >= 0")
         if self.prefill_chunk_tokens is not None:
             if (self.prefill_chunk_tokens < self.page_size
                     or self.prefill_chunk_tokens % self.page_size):
@@ -260,6 +309,43 @@ class DecodeConfig:
             raise ValueError("max_seq_len must be >= page_size")
 
 
+class DecodeJournal:
+    """Host-side durable record of one generation — the replay unit.
+
+    Holds the ORIGINAL prompt and generation cap plus every accepted
+    token, O(tokens) host memory and no KV: together with the request's
+    pinned sampling knobs (seed/temperature) this is a sequence's
+    complete decode state.  On a replica death the pool re-admits the
+    request with ``prompt + accepted`` as the resume prompt and
+    ``remaining()`` as the new cap; absolute-position PRNG folding then
+    reproduces the uninterrupted run bitwise.  ``replays`` counts
+    re-admissions against ``DecodeConfig.replay_budget``.
+    """
+
+    __slots__ = ("prompt0", "max_new0", "accepted", "replays")
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt0 = prompt
+        self.max_new0 = int(max_new_tokens)
+        self.accepted = []           # every token the client will receive
+        self.replays = 0
+
+    def remaining(self):
+        return self.max_new0 - len(self.accepted)
+
+    def resume_prompt(self):
+        """``prompt + accepted`` — what a replay re-prefills.  The chain
+        hashes of the shared prefix are identical to the original
+        prompt's, so surviving prefix-cache pages answer warm."""
+        return np.concatenate(
+            [np.asarray(self.prompt0, np.int32),
+             np.asarray(self.accepted, np.int32)])
+
+    def tokens(self):
+        """The accepted tokens as the client-facing int32 array."""
+        return np.asarray(self.accepted, np.int32)
+
+
 class GenerateRequest(Request):
     """One admitted generation request; doubles as the caller's future.
 
@@ -275,11 +361,17 @@ class GenerateRequest(Request):
     key makes generation deterministic per ``(seed, prompt)`` and
     independent of batch composition.  ``seed=None`` defaults to the
     request's admission seq (stable within a scheduler run; pass an
-    explicit seed for cross-run determinism).
+    explicit seed for cross-run determinism — the replica pool PINS one
+    at admission, because replay re-enqueues the request and a
+    seq-derived seed would change mid-generation).
+
+    ``journal`` is the request's :class:`DecodeJournal`; ``prompt`` /
+    ``max_new_tokens`` are the CURRENT incarnation's (rewritten by
+    replay), the journal keeps the originals and the accepted tokens.
     """
 
     __slots__ = ("prompt", "max_new_tokens", "token_times", "temperature",
-                 "seed")
+                 "seed", "journal", "cancelled")
 
     def __init__(self, prompt, max_new_tokens, deadline=None, priority=None,
                  temperature=None, seed=None):
@@ -290,10 +382,24 @@ class GenerateRequest(Request):
         self.token_times = []
         self.temperature = temperature
         self.seed = seed
+        self.journal = DecodeJournal(prompt, max_new_tokens)
+        self.cancelled = False
 
     @property
     def prompt_len(self):
         return int(self.prompt.shape[0])
+
+    def cancel(self):
+        """Ask the runtime to drop this request: an active sequence is
+        retired (pages freed) at the next iteration boundary, a queued
+        or parked one is dropped at its next admission touch — either
+        way the future fails with ``ServingCancelled`` and the
+        ``serving.decode.cancelled`` counter ticks.  Safe from any
+        thread; returns False when the request already finished."""
+        if self.done():
+            return False
+        self.cancelled = True
+        return True
 
 
 class _Slot:
@@ -334,9 +440,23 @@ class DecodeScheduler:
     One worker thread owns the loop (admit -> decode step -> retire);
     clients only touch the bounded queue and their request futures —
     the same single-dispatcher discipline as the predict batcher.
+
+    Pool mode (ReplicaPool): ``queue=`` injects the SHARED admission
+    queue (the scheduler then never closes or drains it — the pool
+    owns its lifecycle), ``gate=`` a claim predicate consulted before
+    every shared-queue pull (least-loaded dispatch / breaker / replica
+    quiesce), ``name=`` a distinct worker-thread name so the
+    supervisor and the chaos injectors can address one replica's
+    decoder, and ``evict_on_death=True`` switches the worker-death
+    path from fail-the-sequences to LEAVE them harvestable: the pool's
+    restart wrapper calls :meth:`evict_inflight` while the worker is
+    provably dead and re-admits the journals to sibling replicas.
+    ``breaker=`` (a :class:`~.resilient.CircuitBreaker`) records decode
+    dispatch outcomes; the pool's gate consults it for admission.
     """
 
-    def __init__(self, model, config=None, autostart=True):
+    def __init__(self, model, config=None, autostart=True, queue=None,
+                 gate=None, name=None, evict_on_death=False, breaker=None):
         import jax
 
         self.model = model
@@ -371,11 +491,19 @@ class DecodeScheduler:
                            * cfg.page_size)
             buckets = sorted(set(buckets))
         self.prefill_buckets = tuple(buckets)
-        self._queue = RequestQueue(
+        self._owns_queue = queue is None
+        self._queue = queue if queue is not None else RequestQueue(
             cfg.queue_capacity, depth_gauge=_queue_depth,
             full_counter=_queue_full,
             shed_counter=_obs.counter("serving.decode.shed_admission"),
             gauge_prefix="serving.decode.queue_depth")
+        self._gate = gate
+        self._breaker = breaker
+        self._evict_on_death = bool(evict_on_death)
+        # reset_pools safety: the cache refuses to zero pages under
+        # these sequences unless the caller says force=True
+        self._cache.live_seqs = lambda: [
+            s.req.seq for s in self._slots if s is not None]
         self._telemetry = _obs.get_telemetry()
         # pool donation saves an HBM copy per step on chip; CPU jax has no
         # donation and would warn every dispatch
@@ -390,9 +518,16 @@ class DecodeScheduler:
             max_retries=0 if self._donated else cfg.prefill_retries,
             base_delay=0.02, max_delay=0.25,
             classify=_resilience.is_transient_error)
+        # the decode step is replayable for the same reason (functional
+        # pool updates: a failed attempt never touched the current
+        # buffers) — and NOT replayable under donation, identically
+        self._decode_policy = _resilience.RetryPolicy(
+            max_retries=0 if self._donated else cfg.decode_retries,
+            base_delay=0.02, max_delay=0.25,
+            classify=_resilience.is_transient_error)
         self._jit = JitStepCache(
             lambda key: self._build_step(key, donate),
-            cap=len(self.prefill_buckets) + 8, name="decode-steps")
+            cap=2 * len(self.prefill_buckets) + 10, name="decode-steps")
         self._slots = [None] * cfg.num_slots
         self._tables = np.zeros(
             (cfg.num_slots, self._cache.max_pages_per_seq), np.int32)
@@ -411,9 +546,9 @@ class DecodeScheduler:
         # thread lifecycle (single-use Thread re-arming, life lock
         # against start/restart/fail_pending races, BaseException death
         # choke) lives in the shared RestartableWorker — see worker.py
-        self._worker = RestartableWorker(self._serve_loop,
-                                         "paddle-tpu-decode-scheduler",
-                                         label="decoder")
+        self._worker = RestartableWorker(
+            self._serve_loop, name or "paddle-tpu-decode-scheduler",
+            label=name or "decoder")
         if cfg.warmup:
             self.warmup()
         if autostart:
@@ -428,6 +563,12 @@ class DecodeScheduler:
         top_k = self.config.top_k
         if top_k is not None:
             top_k = min(top_k, model.vocab_size)
+        if key[0] == "kvguard":
+            # fused isfinite sweep over the pages a step just wrote;
+            # one compiled program per page-vector length (key[1])
+            from ..parallel.flash_attention import paged_kv_finite
+
+            return jax.jit(paged_kv_finite)
         if key[0] == "decode":
             def decode(tokens, positions, k_pool, v_pool, tables, kv_lens,
                        seeds, temps):
@@ -532,6 +673,17 @@ class DecodeScheduler:
                         jnp.uint32(0), jnp.float32(0))
                     np.asarray(toks)
                     self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+            if cfg.kv_guard:
+                # one guard program per page-vector length the runtime
+                # dispatches: the decode tail sweep ([num_slots]) and
+                # each prefill width's written-page sweep
+                widths = (self._chunk_widths() if self._use_chunks
+                          else self.prefill_buckets)
+                for n in sorted({cfg.num_slots}
+                                | {w // cfg.page_size for w in widths}):
+                    np.asarray(self._jit.get(("kvguard", n))(
+                        self._cache.k_pool, self._cache.v_pool,
+                        jnp.zeros((n,), jnp.int32)))
         return self
 
     # -- lifecycle -----------------------------------------------------------
@@ -584,7 +736,8 @@ class DecodeScheduler:
         and fails them itself)."""
         self._drain = bool(drain)
         self._worker.request_stop()
-        self._queue.close()
+        if self._owns_queue:
+            self._queue.close()
         stopped = self._worker.join(timeout)
         if stopped:
             # leftovers exist only when the worker never ran (or was
@@ -610,9 +763,10 @@ class DecodeScheduler:
                 hol[0].fail(ServingClosed(
                     "engine stopped before request ran (decode worker "
                     "wedged)"))
-            self._queue.drain_remaining(lambda r: ServingClosed(
-                "engine stopped before request ran (decode worker "
-                "wedged)"))
+            if self._owns_queue:
+                self._queue.drain_remaining(lambda r: ServingClosed(
+                    "engine stopped before request ran (decode worker "
+                    "wedged)"))
         return stopped
 
     # -- client API ----------------------------------------------------------
@@ -700,6 +854,12 @@ class DecodeScheduler:
     def _active_count(self):
         return sum(1 for s in self._slots if s is not None)
 
+    def free_slots(self):
+        """Seats this scheduler could fill right now — the pool's
+        least-loaded-dispatch signal.  Read cross-thread (a snapshot
+        under the GIL; staleness only skews one claim decision)."""
+        return self.config.max_active - self._active_count()
+
     def _recover_pools(self, exc):
         """After a failed dispatch with donation enabled (TPU), the pool
         buffers passed in were already consumed — every sequence's cached
@@ -711,7 +871,9 @@ class DecodeScheduler:
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._retire(i, error=exc)
-        self._cache.reset_pools()
+        # force: every owner was just retired above — the live-sequence
+        # guard would otherwise refuse the recovery zeroing itself
+        self._cache.reset_pools(force=True)
 
     def _take_hol(self):
         """Exclusively claim the parked head-of-line entry — a
@@ -741,7 +903,10 @@ class DecodeScheduler:
                 # the worker provably dead (fail_pending/stop enforce it)
                 self._cache.release_prefix(cached_pages)
             req.fail(exc)
-        self._queue.drain_remaining(lambda r: exc)
+        if self._owns_queue:
+            # a SHARED (pool) queue holds sibling replicas' work too;
+            # its drain is the pool's call, never one replica's
+            self._queue.drain_remaining(lambda r: exc)
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._retire(i, error=exc)
@@ -804,11 +969,27 @@ class DecodeScheduler:
             if hol is not None:
                 req, cached_pages, hashes = hol
             else:
+                # the pool's claim gate (least-loaded dispatch, breaker,
+                # replica quiesce) applies to SHARED-queue pulls only —
+                # a parked HOL request already belongs to this replica
+                # (its prefix pages are pinned here)
+                if self._gate is not None and not self._gate():
+                    if not self._active_count():
+                        time.sleep(0.002)  # don't spin while gated out
+                    return
                 req = self._queue.get(
                     timeout=0.0 if self._active_count() else 0.05)
                 cached_pages, hashes = [], None
             if req is None:
                 return
+            if req.cancelled:
+                if cached_pages:
+                    cache.release_prefix(cached_pages)
+                _cancelled.inc()
+                req.fail(ServingCancelled(
+                    "request cancelled before decode started"))
+                self._completed += 1
+                continue
             if req.expired():
                 if cached_pages:
                     cache.release_prefix(cached_pages)
@@ -904,7 +1085,11 @@ class DecodeScheduler:
         own (warmed) bucket — see :meth:`_chunk_widths`."""
         ct = self.config.prefill_chunk_tokens
         if ct is None:
-            return next(b for b in self.prefill_buckets if b >= remaining)
+            # a replay's resume prompt can reach max_seq_len, which may
+            # sit between the last two ladder rungs — fall back to the
+            # largest bucket (>= max_seq_len by construction) and loop
+            return next((b for b in self.prefill_buckets if b >= remaining),
+                        self.prefill_buckets[-1])
         if remaining >= ct:
             return ct
         b = next((b for b in self.prefill_buckets if b >= remaining), ct)
@@ -969,14 +1154,21 @@ class DecodeScheduler:
         except Exception as exc:  # noqa: BLE001 — worker must survive
             self._retire(idx, error=exc)
             self._recover_pools(exc)
+            if self._breaker is not None:
+                self._breaker.record_fatal()
             return
         except BaseException:
-            # worker killed mid-chunk: fail the sequence and release its
-            # reservation before the death propagates.  ServingDegraded
-            # (not ServingError): the engine is sick, the request was
-            # fine — same taxonomy as the batcher death
-            self._retire(idx, error=ServingDegraded(
-                "decode worker died mid-prefill; request aborted"))
+            # worker killed mid-chunk.  Solo mode: fail the sequence and
+            # release its reservation before the death propagates —
+            # ServingDegraded (not ServingError): the engine is sick,
+            # the request was fine, same taxonomy as the batcher death.
+            # Pool mode (evict_on_death): leave the slot INTACT — the
+            # chunk's functional writes never landed, so the slot state
+            # is consistent, and the pool harvests it via
+            # evict_inflight and replays it on a sibling
+            if not self._evict_on_death:
+                self._retire(idx, error=ServingDegraded(
+                    "decode worker died mid-prefill; request aborted"))
             raise
         done = time.perf_counter()
         _prefill_timer.observe(done - t0)
@@ -987,6 +1179,11 @@ class DecodeScheduler:
                 tags=req.trace.child().tags(phase="prefill", bucket=width,
                                             rows=valid, start=start))
         self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        if self._breaker is not None:
+            self._breaker.record_success()
+        if self.config.kv_guard and self._guard_pages(
+                [idx] * len(chunk_vec), chunk_vec, phase="prefill"):
+            return
         slot.prefill_pos = start + valid
         slot.kv_len = slot.prefill_pos
         _prefills.inc()
@@ -1003,6 +1200,7 @@ class DecodeScheduler:
             # final chunk: the sampled token at position prompt_len - 1
             # is the sequence's first generated token
             slot.generated.append(first)
+            req.journal.accepted.append(first)
             req.token_times.append(time.perf_counter())
             # TTFT: admission -> first sampled token, the number an
             # interactive-decode SLO is written against
@@ -1060,17 +1258,24 @@ class DecodeScheduler:
             self._completed += 1
             req.fail(exc)
             self._recover_pools(exc)
+            if self._breaker is not None:
+                self._breaker.record_fatal()
             return
         except BaseException:
             # worker killed mid-prefill: the request is in neither the
-            # queue nor a slot — fail it and release its reservation
-            # before the death propagates, or it would hang forever.
-            # ServingDegraded (not ServingError): the engine is sick,
-            # the request was fine — same taxonomy as the batcher death
+            # queue nor a slot — release its reservation before the
+            # death propagates.  Solo mode: fail it typed or its future
+            # hangs forever (ServingDegraded, not ServingError: the
+            # engine is sick, the request was fine).  Pool mode: park
+            # it head-of-line instead — evict_inflight harvests the HOL
+            # and the pool replays it on a sibling
             self._cache.free(pages)
-            self._completed += 1
-            req.fail(ServingDegraded(
-                "decode worker died mid-prefill; request aborted"))
+            if self._evict_on_death:
+                self._park_hol(req, [], None)
+            else:
+                self._completed += 1
+                req.fail(ServingDegraded(
+                    "decode worker died mid-prefill; request aborted"))
             raise
         done = time.perf_counter()
         _prefill_timer.observe(done - now)
@@ -1083,15 +1288,103 @@ class DecodeScheduler:
                 tags=req.trace.child().tags(phase="prefill", bucket=bucket,
                                             rows=req.prompt_len))
         self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        if self._breaker is not None:
+            self._breaker.record_success()
         slot = _Slot(req, pages)
         slot.generated.append(first)
+        req.journal.accepted.append(first)
         req.token_times.append(time.perf_counter())
         self._slots[idx] = slot
         self._tables[idx] = self._cache.table_row(pages)
         _prefills.inc()
         _tokens.inc()
         _active_slots.set(self._active_count())
+        if self.config.kv_guard and self._guard_pages(
+                [idx] * len(page_vec), page_vec, phase="prefill"):
+            return
         self._finish_if_done(idx)
+
+    def _guard_pages(self, owners, page_vec, phase):
+        """KV integrity sweep over ``page_vec`` (``owners[j]`` = the slot
+        that wrote entry j; scratch-page entries are skipped).  A
+        non-finite page fails its owning slot typed (``KVCorruption``)
+        and scrubs the bad pages — zeroed and dropped from the prefix
+        index — so the poison can't outlive the sequence into a future
+        page owner or a prefix hit.  Returns the set of tripped slot
+        indices (empty = clean)."""
+        import jax.numpy as jnp
+
+        fn = self._jit.get(("kvguard", len(page_vec)))
+        ok = np.asarray(fn(self._cache.k_pool, self._cache.v_pool,
+                           jnp.asarray(page_vec, np.int32)))
+        bad = [j for j in range(len(page_vec))
+               if page_vec[j] and not ok[j]]
+        if not bad:
+            return set()
+        tripped = {}
+        for j in bad:
+            tripped.setdefault(owners[j], []).append(int(page_vec[j]))
+        for idx, pages in tripped.items():
+            slot = self._slots[idx]
+            _kv_guard_trips.inc()
+            self._retire(idx, error=KVCorruption(
+                "non-finite KV write in page(s) %s during %s (seq %s, "
+                "%d/%d tokens); sequence failed, pages scrubbed"
+                % (pages, phase, slot.req.seq, len(slot.generated),
+                   slot.req.max_new_tokens)))
+            # after the retire's free the pages are rc=0 (the guard only
+            # ever trips on privately written pages): zero them and drop
+            # any index entries before the allocator reuses them
+            self._cache.scrub_pages(pages)
+        return set(tripped)
+
+    def evict_inflight(self):
+        """Harvest every in-flight sequence for replay elsewhere: clear
+        the slots and the parked HOL entry, free their pages and pinned
+        prefix references, and return the (unfailed) requests — futures
+        untouched, journals intact.  The pool's supervisor calls this
+        between a replica death and the worker restart, while the
+        worker is provably dead (the caller holds that proof via the
+        supervisor's is-alive check), then re-admits each request to a
+        sibling replica.  With donation the pools are also reset — the
+        dying dispatch may have consumed them."""
+        harvested = []
+        hol = self._take_hol()
+        if hol is not None:
+            req, cached_pages, _ = hol
+            if cached_pages:
+                self._cache.release_prefix(cached_pages)
+            if not req.done():
+                harvested.append(req)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._slots[i] = None
+            self._tables[i] = 0
+            self._cache.free(slot.pages)
+            if not slot.req.done():
+                harvested.append(slot.req)
+        if self._donated:
+            self._cache.reset_pools(force=True)
+        _active_slots.set(0)
+        return harvested
+
+    def evict_if_dead(self):
+        """:meth:`evict_inflight` under the dead-worker proof — the
+        pool's supervisor paths call this so a racing operator
+        ``start()`` can never land a revived worker on top of an
+        eviction in progress (the worker's life lock serializes the
+        aliveness check with any spawn).  Returns None (no-op) while
+        the worker is alive."""
+        with self._worker.life_lock:
+            if self._worker.alive:
+                return None
+            return self.evict_inflight()
+
+    def idle(self):
+        """No active sequence and no parked head-of-line request (the
+        pool's decode-drain probe)."""
+        return self._active_count() == 0 and self._hol is None
 
     def _finish_if_done(self, idx):
         slot = self._slots[idx]
@@ -1110,6 +1403,15 @@ class DecodeScheduler:
         # them — checked BETWEEN chunks too, so a doomed long prompt
         # frees its budget early instead of prefilling to completion
         now0 = time.perf_counter()
+        # cancellation reaps at the iteration boundary: the slot retires
+        # and its pages free before the next step dispatches, so an
+        # abandoned future stops burning decode capacity immediately
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.req.cancelled:
+                _cancelled.inc()
+                self._retire(i, error=ServingCancelled(
+                    "request cancelled after %d/%d generated tokens"
+                    % (len(slot.generated), slot.req.max_new_tokens)))
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.expired(now0):
                 req = slot.req
@@ -1182,34 +1484,72 @@ class DecodeScheduler:
             tables[masked] = 0
         fn = self._jit.get(("decode",))
         t0 = time.perf_counter()
-        try:
+
+        def attempt():
+            # the chaos choke point is consulted per ATTEMPT (a retry
+            # is a fresh dispatch, exactly like the prefill legs')
             serve_fault = _resilience._serve_fault
             if serve_fault is not None:
                 serve_fault([s.req for _, s in active])
             with self._telemetry.timed("serving.decode.step",
                                        active=len(active)):
-                out, k_pool, v_pool = fn(
+                out, kp, vp = fn(
                     jnp.asarray(tokens), jnp.asarray(positions),
                     self._cache.k_pool, self._cache.v_pool,
                     jnp.asarray(tables), jnp.asarray(kv_lens),
                     jnp.asarray(seeds), jnp.asarray(temps))
-                sampled = np.asarray(out)
+                return np.asarray(out), kp, vp
+
+        def note_retry(exc, attempt_n, delay):
+            _step_retries.inc()
+            tel = self._telemetry
+            if tel.recording:
+                tel.emit({
+                    "type": "serving_retry", "ts": time.time(),
+                    "source": "serving", "leg": "decode_step",
+                    "error": repr(exc)[:200], "attempt": attempt_n,
+                    "delay_s": delay, "active": len(active),
+                })
+
+        try:
+            sampled, k_pool, v_pool = _resilience.call_with_retry(
+                attempt, policy=self._decode_policy, on_retry=note_retry)
         except Exception as exc:  # noqa: BLE001 — worker must survive
+            # fatal (or transient past the retry budget): fail the
+            # actives typed, un-retried — replay can't fix a
+            # deterministic fault
             for i, _ in active:
                 self._retire(i, error=exc)
             self._recover_pools(exc)
+            if self._breaker is not None:
+                self._breaker.record_fatal()
             return
         step_s = time.perf_counter() - t0
         _decode_timer.observe(step_s)
         _step_hist.observe(step_s)
         self._cache.k_pool, self._cache.v_pool = k_pool, v_pool
+        if self._breaker is not None:
+            self._breaker.record_success()
+        tripped = ()
+        if cfg.kv_guard:
+            # sweep each active slot's TAIL page — the one this step's
+            # token write landed in (position = pre-step kv_len)
+            guard_vec = np.zeros((cfg.num_slots,), np.int32)
+            owners = list(range(cfg.num_slots))
+            for i, slot in active:
+                guard_vec[i] = slot.pages[slot.kv_len // cfg.page_size]
+            tripped = self._guard_pages(owners, guard_vec, phase="decode")
         now = time.perf_counter()
         for i, slot in active:
+            if i in tripped:
+                continue           # retired typed by the guard
             slot.kv_len += 1
-            slot.generated.append(int(sampled[i]))
+            tok = int(sampled[i])
+            slot.generated.append(tok)
+            slot.req.journal.accepted.append(tok)
             slot.req.token_times.append(now)
         _steps.inc()
-        _tokens.inc(len(active))
+        _tokens.inc(len(active) - len(tripped))
         for i, _ in active:
             if self._slots[i] is not None:
                 self._finish_if_done(i)
@@ -1234,7 +1574,9 @@ class DecodeScheduler:
         if error is not None:
             req.fail(error)
         else:
-            req.complete(np.asarray(slot.generated, np.int32))
+            # the journal, not the slot: after a replay the slot only
+            # holds this incarnation's tokens, the journal all of them
+            req.complete(req.journal.tokens())
         _retired.inc()
         _active_slots.set(self._active_count())
         tel = self._telemetry

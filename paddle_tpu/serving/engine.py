@@ -37,10 +37,14 @@ Overload/failure contracts (the resilience layer, docs/serving.md):
 requests carry a priority class and optional deadline; admission sheds
 deadline-doomed requests with ``ServingOverloaded`` BEFORE queueing;
 predict dispatch faults are retried (transient), bisected (poison),
-and breaker-counted (persistent), while decode dispatch faults fail
-their active sequences typed without retry; a dead worker thread is
-restarted by the supervisor or pending requests fail fast — an
-admitted request always reaches a terminal outcome.
+and breaker-counted (persistent); decode dispatch faults retry
+transients in place (``DecodeConfig.decode_retries`` — the paged
+pools are functional, a failed attempt left them intact) and fail
+their active sequences typed past the budget or on a fatal fault; a
+dead worker thread is restarted by the supervisor or pending requests
+fail fast — an admitted request always reaches a terminal outcome
+(and in a ``ReplicaPool`` with ``decode_model=``, a dead decode
+worker's in-flight generations replay bitwise on sibling replicas).
 """
 from __future__ import annotations
 

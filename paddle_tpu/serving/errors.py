@@ -5,11 +5,15 @@ can see maps to one of these, so callers distinguish "shed this request"
 (``ServingQueueFull`` / ``ServingOverloaded`` — retry elsewhere / later),
 "the request ran out of time" (``ServingTimeout`` — its deadline expired
 in queue or while waiting), "the engine is sick" (``ServingDegraded`` —
-circuit breaker open or worker dead, fast-fail until it heals), and "the
-engine is gone" (``ServingClosed``) without string matching.
-``ServingError`` also covers request-shape mistakes (unknown feed name,
-rows over ``max_batch_size``), which are programming errors — no retry
-will fix them.
+circuit breaker open or worker dead, fast-fail until it heals), "the
+engine is gone" (``ServingClosed``), "the caller gave up"
+(``ServingCancelled`` — the request's own ``cancel()``), and "the KV
+state went bad" (``KVCorruption`` — the integrity sweep caught a
+non-finite cache write; the sequence is unrecoverable but the pool is
+scrubbed) without string matching.  ``ServingError`` also covers
+request-shape mistakes (unknown feed name, rows over
+``max_batch_size``), which are programming errors — no retry will fix
+them.
 """
 from __future__ import annotations
 
@@ -20,6 +24,8 @@ __all__ = [
     "ServingOverloaded",
     "ServingDegraded",
     "ServingClosed",
+    "ServingCancelled",
+    "KVCorruption",
 ]
 
 
@@ -56,3 +62,20 @@ class ServingDegraded(ServingError):
 
 class ServingClosed(ServingError):
     """The engine is stopped (or stopping) and no longer admits requests."""
+
+
+class ServingCancelled(ServingError):
+    """The caller cancelled the request (``GenerateRequest.cancel()``).
+    The decode runtime retires the sequence and frees its KV pages at
+    the next iteration boundary; a queued or parked request is dropped
+    without ever occupying a slot."""
+
+
+class KVCorruption(ServingError):
+    """The opt-in KV integrity sweep (``DecodeConfig(kv_guard=True)``)
+    found a non-finite value in a page this sequence just wrote.  Only
+    the owning sequence fails — its pages are scrubbed (zeroed and
+    dropped from the prefix index) before returning to the pool, so
+    co-resident and prefix-sharing sequences are untouched.  Replay
+    would recompute the same write, so the failure is terminal, not
+    retried."""

@@ -163,16 +163,42 @@ class PagedKVCache:
         # every admission, and an O(num_pages) scan there would put a
         # pool-sized interpreted loop on the serving hot path
         self._shared = 0
+        # scheduler-installed callback: () -> iterable of live seq ids.
+        # reset_pools consults it so nothing can zero pages out from
+        # under a running scheduler without saying force=True.
+        self.live_seqs = None
         _pages_total.set(self.num_pages - 1)
         self._publish(0)
 
-    def reset_pools(self):
+    def reset_pools(self, force=False):
         """Reallocate zeroed pools (allocator state untouched).  The
         recovery path after a failed DONATED dispatch, whose consumed
         input buffers are gone either way.  The prefix index is FLUSHED —
-        its entries describe page contents that no longer exist."""
+        its entries describe page contents that no longer exist.
+
+        Zeroing pages under sequences that still decode from them would
+        silently corrupt their output, so when the owning scheduler has
+        installed a ``live_seqs`` callback and it reports active
+        sequences (or, with no callback, when any page is still rc>=1),
+        this raises a typed :class:`ServingError` listing them unless
+        ``force=True`` — recovery paths that have already evicted or
+        failed their sequences pass ``force=True``."""
         import jax.numpy as jnp
 
+        if not force:
+            live = (sorted(self.live_seqs())
+                    if self.live_seqs is not None else None)
+            if live:
+                raise ServingError(
+                    "reset_pools would zero KV under %d live sequence(s) "
+                    "(seq %s); retire or evict them first, or pass "
+                    "force=True from a recovery path"
+                    % (len(live), ", ".join(str(s) for s in live)))
+            if live is None and self._used:
+                raise ServingError(
+                    "reset_pools would zero %d allocated page(s) with no "
+                    "live_seqs callback installed; pass force=True if "
+                    "their owners are already failed" % self._used)
         shape = (self.num_layers, self.num_pages, self.page_size,
                  self.num_heads, self.head_dim)
         self.k_pool = jnp.zeros(shape, self.dtype)
@@ -183,6 +209,36 @@ class PagedKVCache:
             self._free.append(p)
         self._lru.clear()
         _cached_pages.set(0)
+
+    def scrub_pages(self, pages):
+        """Zero the given pages in both pools and drop their prefix-index
+        entries — the hygiene step after the KV integrity sweep trips.
+        Unlike normal retirement (where stale values are unreachable
+        because reads mask by ``kv_lens``), a NON-FINITE stale value is
+        reachable arithmetic: the reference paged attention multiplies
+        masked positions by probability 0, and ``0 * nan = nan`` would
+        poison every future owner of the page.  Pages still shared
+        (rc >= 2) are skipped — they predate the corrupt write and other
+        readers depend on them; only their index entries stay (their
+        content is intact)."""
+        import jax.numpy as jnp
+
+        scrub = [int(p) for p in pages if p != 0 and self._rc[p] <= 1]
+        if not scrub:
+            return
+        idx = jnp.asarray(scrub, jnp.int32)
+        zero = jnp.zeros((self.num_layers, len(scrub), self.page_size,
+                          self.num_heads, self.head_dim), self.dtype)
+        self.k_pool = self.k_pool.at[:, idx].set(zero)
+        self.v_pool = self.v_pool.at[:, idx].set(zero)
+        for p in scrub:
+            h = self._hash_of_page.pop(p, None)
+            if h is not None:
+                self._index.pop(h, None)
+            if p in self._lru:
+                del self._lru[p]
+                self._free.append(p)
+        _cached_pages.set(len(self._lru))
 
     # -- allocator -----------------------------------------------------------
     @property

@@ -54,6 +54,34 @@ serves them.  ``tools/check_replica_pool.py`` gates this, the >=2.5x
 kill/eject/revive cycle on the forced-host-device CPU mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
 
+**Pool-routed decode** (ISSUE 17): pass ``decode_model=`` (a
+:class:`~.decode_scheduler.DecodeModel`) and the pool serves
+``generate()`` / ``generate_async()`` too — each replica runs its own
+:class:`~.decode_scheduler.DecodeScheduler` (own ``PagedKVCache``, own
+warmed chunk/decode programs, pools committed to its device) behind ONE
+shared decode :class:`~.request_queue.RequestQueue`, claimed
+least-loaded-by-free-slots: a replica pulls only when no decode-ready
+sibling has more free seats (ties claim, so equal replicas race the
+queue and FIFO wins — no livelock).  Generation is *durable*: every
+request's :class:`~.decode_scheduler.DecodeJournal` makes its decode
+state portable, so when a replica's decode worker dies the supervisor
+restart wrapper harvests the in-flight sequences
+(:meth:`~.decode_scheduler.DecodeScheduler.evict_inflight`, run while
+the worker is provably dead) and re-admits them to siblings, which
+re-prefill ``prompt + accepted-so-far`` (prefix-cache warm where pages
+survive) and continue BITWISE-identically — the sampling seed is pinned
+at pool admission (a monotonic counter when the caller passes none),
+because replay re-enqueues the request and a queue-seq-derived seed
+would change mid-generation.  Re-admissions count on
+``serving.decode.replays`` against ``DecodeConfig.replay_budget``
+(typed ``ServingDegraded`` past it); each replica's decode dispatches
+feed a per-replica decode breaker
+(``serving.replica.decode_breaker_<i>``) consulted by its claim gate.
+Autoscale quiesce and rolling predict-model swaps exclude a replica
+from NEW decode claims (its active sequences finish in place); the
+decode model itself is fixed at construction.  A pool built with
+``model_dir=None`` serves decode only.
+
 Telemetry: pool-level gauges ``serving.replica.pool_size`` /
 ``.active`` / ``.ready``; per-replica ``serving.replica.state_<i>``
 (0 parked / 1 serving / 2 draining / 3 ejected / 4 dead),
@@ -70,9 +98,12 @@ from __future__ import annotations
 import threading
 import time
 
+import numpy as np
+
 from .. import core as _core
 from .. import observability as _obs
 from .batcher import CompletionTracker, DynamicBatcher
+from .decode_scheduler import DecodeConfig, DecodeScheduler, GenerateRequest
 from .engine import BatchExecutor, normalize_feed
 from .errors import ServingClosed, ServingDegraded, ServingError
 from .model_store import ModelStore
@@ -89,6 +120,10 @@ _ready_gauge = _obs.gauge("serving.replica.ready")
 _scale_ups = _obs.counter("serving.replica.scale_ups")
 _scale_downs = _obs.counter("serving.replica.scale_downs")
 _replica_swapped = _obs.counter("serving.replica.swapped")
+# decode-path counters shared (by name) with decode_scheduler.py: pool
+# admission and replay tick the same registry entries the schedulers do
+_decode_requests = _obs.counter("serving.decode.requests")
+_decode_replays = _obs.counter("serving.decode.replays")
 
 #: serving.replica.state_<i> gauge codes
 REPLICA_STATES = {"parked": 0, "serving": 1, "draining": 2, "ejected": 3,
@@ -128,6 +163,9 @@ class _Replica:
         self.draining = False       # rolling-swap pause
         self.failed = False         # worker dead past its restart budget
         self.force_serve = False    # pool stop-drain: bypass the breaker
+        self.decoder = None         # DecodeScheduler (decode_model= pools)
+        self.decode_breaker = None  # its per-replica CircuitBreaker
+        self.decode_failed = False  # decode worker dead past budget
         self.inflight_rows = 0      # rows the worker is dispatching NOW
         self.dispatches = 0
         self.rows_served = 0
@@ -276,7 +314,7 @@ class _Replica:
         self._state_gauge.set(REPLICA_STATES[self.state()])
 
     def stats(self):
-        return {
+        st = {
             "index": self.index,
             "device": str(self.device),
             "state": self.state(),
@@ -290,6 +328,13 @@ class _Replica:
             "rows_served": self.rows_served,
             "batches": self.batcher.batches,
         }
+        if self.decoder is not None:
+            d = self.decoder.stats()
+            d.update(alive=self.decoder.alive, failed=self.decode_failed,
+                     breaker=self.decode_breaker.state,
+                     free_slots=self.decoder.free_slots())
+            st["decode"] = d
+        return st
 
 
 class ReplicaPool:
@@ -314,6 +359,12 @@ class ReplicaPool:
     scale_down_after_s: hysteresis — desired must stay below the active
         count this long before a scale-down is applied (scale-UP is
         immediate; overload hurts now, idle capacity only costs money).
+    decode_model / decode_config: enable pool-routed generation — one
+        :class:`~.decode_scheduler.DecodeScheduler` per replica behind a
+        shared decode queue with least-loaded claim dispatch, durable
+        replay-on-death, and per-replica decode breakers (see the
+        module docstring).  ``model_dir=None`` builds a decode-only
+        pool (``predict`` then rejects typed).
     """
 
     def __init__(self, model_dir, replicas=None, devices=None,
@@ -325,7 +376,8 @@ class ReplicaPool:
                  autostart=True, execute_retries=2, breaker_threshold=5,
                  breaker_cooldown_s=1.0, supervise=True,
                  worker_max_restarts=3, supervisor_interval_s=0.1,
-                 scale_down_after_s=5.0):
+                 scale_down_after_s=5.0, decode_model=None,
+                 decode_config=None):
         import jax
 
         buckets = sorted(set(int(b) for b in batch_buckets))
@@ -366,10 +418,15 @@ class ReplicaPool:
         self._metrics_server = None
         self._replicas = [_Replica(self, i, devices[i % len(devices)])
                           for i in range(n)]
-        for rep in self._replicas:
-            rep.model = rep.load_model(model_dir, backend)
-            if self._warmup:
-                rep.model.warmup(self.batch_buckets)
+        if model_dir is None and decode_model is None:
+            raise ServingError(
+                "pass model_dir= (predict), decode_model= (generate), "
+                "or both — an empty pool serves nothing")
+        if model_dir is not None:
+            for rep in self._replicas:
+                rep.model = rep.load_model(model_dir, backend)
+                if self._warmup:
+                    rep.model.warmup(self.batch_buckets)
         active0 = self.max_replicas if initial_replicas is None else max(
             self.min_replicas, min(int(initial_replicas),
                                    self.max_replicas))
@@ -379,6 +436,48 @@ class ReplicaPool:
         # ejects, autoscale parks, worker deaths/revivals all reflect at
         # the next admission estimate with no bookkeeping at each flip
         self._queue.set_parallelism(lambda: max(1, len(self._ready())))
+        self._decode_enabled = decode_model is not None
+        self._decode_config = None
+        self._decode_queue = None
+        if self._decode_enabled:
+            dcfg = self._decode_config = decode_config or DecodeConfig()
+            # admission-order seed pinning: replay re-enqueues a request
+            # (reassigning its queue seq), so a seedless sampling request
+            # gets a POOL-pinned seed here — stable across replays, and
+            # identical between a fault-free and a faulted run admitting
+            # the same requests in the same order
+            self._decode_seed_lock = threading.Lock()
+            self._decode_admissions = 0
+            self._decode_queue = RequestQueue(
+                dcfg.queue_capacity,
+                depth_gauge=_obs.gauge("serving.decode.queue_depth"),
+                full_counter=_obs.counter("serving.decode.queue_full"),
+                shed_counter=_obs.counter("serving.decode.shed_admission"),
+                gauge_prefix="serving.decode.queue_depth")
+            self._decode_queue.set_parallelism(
+                lambda: max(1, sum(1 for r in self._replicas
+                                   if self._decode_ready(r))))
+            for rep in self._replicas:
+                rep.decode_breaker = CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    cooldown_s=self._breaker_cooldown_s,
+                    state_gauge=_obs.gauge(
+                        "serving.replica.decode_breaker_%d" % rep.index))
+                # build + warm INSIDE the device scope so the KV pools,
+                # compiled steps, and warmup dispatches all land on this
+                # replica's device; then COMMIT the pools — the worker
+                # thread dispatches outside any scope, and committed
+                # pool args are what keep the step on this device
+                with jax.default_device(rep.device):
+                    rep.decoder = DecodeScheduler(
+                        decode_model, config=dcfg, autostart=False,
+                        queue=self._decode_queue,
+                        gate=(lambda r=rep: self._decode_gate(r)),
+                        name="decode-replica%d" % rep.index,
+                        evict_on_death=True, breaker=rep.decode_breaker)
+                    cache = rep.decoder._cache
+                    cache.k_pool = jax.device_put(cache.k_pool, rep.device)
+                    cache.v_pool = jax.device_put(cache.v_pool, rep.device)
         self._supervisor = None
         if supervise:
             sup = WorkerSupervisor(interval_s=supervisor_interval_s,
@@ -392,6 +491,15 @@ class ReplicaPool:
                     is_alive=lambda r=rep: r.batcher.alive,
                     restart=rep.batcher.restart,
                     fail_pending=self._fail_pending_if_all_dead)
+                if rep.decoder is not None:
+                    sup.watch(
+                        "decode-replica%d" % rep.index,
+                        should_run=lambda r=rep: (
+                            r.decoder.started and not r.decoder.stopping),
+                        is_alive=lambda r=rep: r.decoder.alive,
+                        restart=lambda r=rep: self._revive_decoder(r),
+                        fail_pending=lambda r=rep:
+                            self._decode_fail_pending(r))
             self._supervisor = sup
         self._autoscaler_stop = threading.Event()
         self._autoscaler = None
@@ -413,6 +521,13 @@ class ReplicaPool:
                     rep.failed = False
                     if self._supervisor is not None:
                         self._supervisor.reset("replica%d" % rep.index)
+            if rep.decoder is not None and not rep.decoder.alive:
+                rep.decoder.start()
+                if rep.decoder.alive:
+                    rep.decode_failed = False
+                    if self._supervisor is not None:
+                        self._supervisor.reset(
+                            "decode-replica%d" % rep.index)
         if self._supervisor is not None:
             self._supervisor.start()
         self._publish()
@@ -430,6 +545,8 @@ class ReplicaPool:
             self._state = "stopped"
             self.stop_autoscaler()
             self._queue.close()
+            if self._decode_queue is not None:
+                self._decode_queue.close()
             for rep in self._replicas:
                 # open every gate: the drain wants ALL warm capacity, and
                 # a parked worker must observe `stopping` and exit
@@ -456,6 +573,14 @@ class ReplicaPool:
                 # a wedged worker keeps its model open (same forced-
                 # shutdown edge as the engine: never close an executable
                 # under a running batch)
+            if self._decode_enabled:
+                # schedulers never close/drain the SHARED queue (they
+                # don't own it) — stop them first, then fail whatever
+                # no worker ever claimed
+                for rep in self._replicas:
+                    rep.decoder.stop(drain=drain, timeout=timeout)
+                self._decode_queue.drain_remaining(
+                    lambda r: ServingClosed("replica pool is stopped"))
             if self._supervisor is not None:
                 self._supervisor.stop()
             if self._metrics_server is not None:
@@ -472,6 +597,11 @@ class ReplicaPool:
 
     # -- worker failure ------------------------------------------------------
     def _on_worker_give_up(self, worker_name):
+        if worker_name.startswith("decode-replica"):
+            rep = self._replicas[int(worker_name[len("decode-replica"):])]
+            rep.decode_failed = True
+            self._publish()
+            return
         idx = int(worker_name.replace("replica", ""))
         rep = self._replicas[idx]
         rep.failed = True
@@ -497,6 +627,111 @@ class ReplicaPool:
             lambda r: ServingDegraded(
                 "every pool replica is dead past its restart budget"),
             on_fail=lambda r: self._tracker.mark_done([r]))
+
+    # -- durable decode (pool-routed generation) -----------------------------
+    def _decode_ready(self, rep):
+        """This replica's decoder can claim shared-queue work right now
+        (the sibling side of the least-loaded comparison — state-only,
+        never ``allow()``: probing a sibling's half-open breaker must
+        not consume its probe slot)."""
+        return (rep.active and not rep.draining and not rep.decode_failed
+                and rep.decoder.alive
+                and rep.decode_breaker.state != "open")
+
+    def _decode_admissible(self, rep):
+        """Could serve an admitted generation soon: not given-up and not
+        breaker-open (a dead worker inside its restart budget counts —
+        the supervisor revives it, and its in-flight journals replay on
+        siblings meanwhile)."""
+        return (rep.decoder is not None and not rep.decode_failed
+                and rep.decode_breaker.state != "open")
+
+    def _decode_gate(self, rep):
+        """Claim gate for one replica's DecodeScheduler, consulted
+        before every shared-queue pull (a parked HOL request is exempt
+        — its prefix pages are pinned locally).  Least-loaded-by-free-
+        slots: claim only when no decode-ready sibling has MORE free
+        seats; ties claim, so equal replicas race the queue and FIFO
+        decides — no livelock, and a draining/quiesced/broken replica
+        simply stops claiming while its active sequences finish."""
+        if rep.force_serve and not rep.decode_failed:
+            # pool stop-drain: every queued generation must reach a
+            # terminal outcome NOW
+            return True
+        if (not rep.active or rep.draining or rep.decode_failed
+                or not rep.decode_breaker.allow()):
+            return False
+        mine = rep.decoder.free_slots()
+        others = [r.decoder.free_slots() for r in self._replicas
+                  if r is not rep and self._decode_ready(r)]
+        return not others or mine >= max(others)
+
+    def _revive_decoder(self, rep):
+        """The supervisor's restart wrapper for one replica's decode
+        worker: FIRST harvest the in-flight sequences (under the
+        dead-worker proof — pages freed, journals intact), re-admit
+        them so siblings pick them up immediately, THEN re-arm the
+        thread.  The revived worker comes back with empty slots and the
+        shared queue decides what it serves next."""
+        for req in rep.decoder.evict_if_dead() or ():
+            self._readmit_decode(req)
+        return rep.decoder.restart()
+
+    def _decode_fail_pending(self, rep):
+        """Give-up tick for one replica's decode worker (dead past its
+        restart budget): its in-flight sequences replay on siblings —
+        durable decode means a lost replica loses NO generation — and
+        the shared queue is drained typed only once no decoder could
+        ever serve it."""
+        for req in rep.decoder.evict_if_dead() or ():
+            self._readmit_decode(req)
+        if any(r.decoder.alive and not r.decode_failed
+               for r in self._replicas):
+            return
+        self._decode_queue.drain_remaining(
+            lambda r: ServingDegraded(
+                "every pool decode replica is dead past its restart "
+                "budget"))
+
+    def _readmit_decode(self, req):
+        """Re-admit one harvested generation: rewrite the request to
+        resume from its journal (``prompt + accepted`` re-prefilled,
+        the remaining cap as the new budget — bitwise-identical
+        continuation via absolute-position PRNG folding) and re-enqueue
+        it, counting against ``DecodeConfig.replay_budget``."""
+        if req.done():
+            return
+        j = req.journal
+        if j.remaining() <= 0:
+            # every token was already accepted when the replica died —
+            # nothing to replay, the journal IS the answer
+            req.complete(j.tokens())
+            return
+        if j.replays >= self._decode_config.replay_budget:
+            req.fail(ServingDegraded(
+                "replica died mid-decode and the replay budget (%d) is "
+                "spent after %d/%d tokens"
+                % (self._decode_config.replay_budget, len(j.accepted),
+                   j.max_new0)))
+            return
+        j.replays += 1
+        _decode_replays.inc()
+        req.prompt = j.resume_prompt()
+        req.max_new_tokens = j.remaining()
+        if self._telemetry.recording:
+            self._telemetry.emit({
+                "type": "decode_replay", "ts": time.time(),
+                "source": "serving", "seq": req.seq,
+                "accepted": len(j.accepted), "remaining": j.remaining(),
+                "replays": j.replays,
+            })
+        try:
+            # re-put re-runs admission (a fresh seq, deadline-aware
+            # shed against the ORIGINAL absolute deadline): a doomed or
+            # over-capacity replay fails typed here instead of hanging
+            self._decode_queue.put(req)
+        except ServingError as exc:
+            req.fail(exc)
 
     # -- introspection -------------------------------------------------------
     def _active(self):
@@ -538,7 +773,12 @@ class ReplicaPool:
         will within the supervisor's restart budget)."""
         if self._state not in ("ready", "swapping"):
             return False
-        return any(r.admissible() for r in self._replicas)
+        if any(r.admissible() for r in self._replicas):
+            return True
+        # decode-only pool (model_dir=None): the predict side never
+        # becomes admissible, the decode side is what serves
+        return self._decode_enabled and any(
+            self._decode_admissible(r) for r in self._replicas)
 
     def replica_stats(self):
         return [r.stats() for r in self._replicas]
@@ -570,6 +810,13 @@ class ReplicaPool:
             "batches": sum(r.batcher.batches for r in self._replicas),
             "per_replica": self.replica_stats(),
         }
+        if self._decode_enabled:
+            h["decode"] = {
+                "queue_depth": self._decode_queue.depth(),
+                "admitted": self._decode_queue.last_seq(),
+                "ready_replicas": sum(1 for r in self._replicas
+                                      if self._decode_ready(r)),
+            }
         if self._supervisor is not None:
             h["workers"] = self._supervisor.stats()
         return h
@@ -647,6 +894,91 @@ class ReplicaPool:
         return self.predict_async(
             feed, deadline_ms=deadline_ms, priority=priority).result(
             timeout=timeout)
+
+    def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None,
+                       priority=None, temperature=None, seed=None):
+        """Admit one generation into the SHARED decode queue; whichever
+        least-loaded decode-ready replica claims it serves it — and if
+        that replica dies mid-decode, the journal replays the sequence
+        on a sibling bitwise-identically (see the module docstring).
+        Same per-request knobs as
+        :meth:`~.decode_scheduler.DecodeScheduler.submit`; a seedless
+        request gets a pool-pinned admission-order seed (stable across
+        replays).  Requires ``decode_model=`` at construction."""
+        if not self._decode_enabled:
+            raise ServingError(
+                "pool has no decode model (pass decode_model= at "
+                "construction)")
+        if self._state == "stopped":
+            raise ServingClosed("replica pool is stopped")
+        if self._state == "loading":
+            raise ServingClosed("replica pool is still loading")
+        if not any(self._decode_admissible(r) for r in self._replicas):
+            raise ServingDegraded(
+                "no replica can decode: all dead past restart budget or "
+                "circuit-broken; pool degraded")
+        dcfg = self._decode_config
+        tokens = np.asarray(prompt)
+        if tokens.ndim != 1 or tokens.shape[0] < 1:
+            raise ServingError(
+                "prompt must be a non-empty 1-D token array, got shape %s"
+                % (tokens.shape,))
+        tokens = tokens.astype(np.int32, copy=False)
+        n_new = int(dcfg.max_new_tokens if max_new_tokens is None
+                    else max_new_tokens)
+        if n_new < 1:
+            raise ServingError("max_new_tokens must be >= 1")
+        buckets = self._replicas[0].decoder.prefill_buckets
+        plen = int(tokens.shape[0])
+        if plen > buckets[-1]:
+            raise ServingError(
+                "prompt length %d exceeds the largest prefill bucket %d"
+                % (plen, buckets[-1]))
+        if plen + n_new > dcfg.max_seq_len:
+            raise ServingError(
+                "prompt %d + max_new_tokens %d exceeds max_seq_len %d"
+                % (plen, n_new, dcfg.max_seq_len))
+        if temperature is not None and float(temperature) < 0:
+            raise ServingError("temperature must be >= 0, got %r"
+                               % (temperature,))
+        if priority is not None and priority not in PRIORITY_CLASSES:
+            raise ServingError("unknown priority class %r (know %s)"
+                               % (priority, PRIORITY_CLASSES))
+        if seed is None:
+            with self._decode_seed_lock:
+                seed = self._decode_admissions
+                self._decode_admissions += 1
+        ms = deadline_ms if deadline_ms is not None \
+            else dcfg.default_deadline_ms
+        deadline = None if ms is None else time.perf_counter() + ms / 1e3
+        req = self._decode_queue.put(
+            GenerateRequest(tokens, n_new, deadline=deadline,
+                            priority=priority, temperature=temperature,
+                            seed=seed))
+        _decode_requests.inc()
+        return req
+
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
+                 timeout=None, priority=None, temperature=None, seed=None):
+        """Synchronous generate: the generated int32 token ids."""
+        return self.generate_async(
+            prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
+            priority=priority, temperature=temperature,
+            seed=seed).result(timeout=timeout)
+
+    def drain_decode(self, timeout=None):
+        """Block until no generation is queued, parked, or decoding
+        anywhere in the pool.  False on timeout."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        while True:
+            if self._decode_queue is None or (
+                    self._decode_queue.depth() == 0
+                    and all(r.decoder.idle() for r in self._replicas)):
+                return True
+            if deadline is not None and time.perf_counter() >= deadline:
+                return False
+            time.sleep(0.005)
 
     def drain(self, timeout=None):
         """Block until everything admitted so far has reached a terminal

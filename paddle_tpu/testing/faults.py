@@ -37,6 +37,15 @@ same way:
         ...                                  # next dispatch KILLS the
                                              # worker thread (supervisor!)
 
+Durable-decode chaos (ISSUE 17) rides the same choke point:
+:func:`kill_replica_mid_decode` kills exactly ONE pool replica's decode
+worker (matched by thread name) once it is provably mid-generation, so
+the pool's evict-and-replay path is what completes the sequences;
+:func:`corrupt_kv_page` writes NaN into a page a decoding sequence owns
+(on the owning worker thread, pre-dispatch), which the opt-in
+``kv_guard`` sweep must catch; and plain :func:`flaky_execute` fires at
+the decode-step dispatch too, exercising ``decode_retries``.
+
 No global monkeypatching: only code routed through the resilience
 primitives (checkpoint IO, ``Executor.run`` feeds, serving dispatch)
 sees the faults, and exiting the context always restores the hooks.
@@ -64,6 +73,8 @@ __all__ = [
     "slow_execute",
     "poison_request",
     "kill_worker",
+    "kill_replica_mid_decode",
+    "corrupt_kv_page",
 ]
 
 
@@ -322,4 +333,80 @@ def kill_worker(at_dispatch=0):
 
     with _serve_fault_installed(hook):
         yield count
+
+
+@contextlib.contextmanager
+def kill_replica_mid_decode(index, min_tokens=1):
+    """KILL one pool replica's DECODE worker provably mid-generation:
+    the hook fires only on the thread named ``decode-replica<index>``
+    (each pool replica's :class:`~..serving.decode_scheduler
+    .DecodeScheduler` worker carries that name), and only once some
+    request in the dispatch has already accepted ``min_tokens`` tokens
+    — so the dying replica is holding real in-flight KV, which is
+    exactly the state the pool's evict-and-replay durability path must
+    recover on a sibling.  Raises :class:`WorkerKilled` once; sibling
+    replicas never see the hook fire.  Yields a one-item list with the
+    kill count."""
+    import threading
+
+    name = "decode-replica%d" % int(index)
+    need = int(min_tokens)
+    fired = [0]
+
+    def hook(requests):
+        if fired[0] or threading.current_thread().name != name:
+            return
+        if not any(len(r.journal.accepted) >= need
+                   for r in requests if hasattr(r, "journal")):
+            return
+        fired[0] += 1
+        raise WorkerKilled("injected replica kill mid-decode (%s)" % name)
+
+    with _serve_fault_installed(hook):
+        yield fired
+
+
+@contextlib.contextmanager
+def corrupt_kv_page(scheduler, seq=None, after_tokens=1):
+    """Write NaN into a KV page OWNED by a decoding sequence on
+    ``scheduler`` — the poison the opt-in ``DecodeConfig(kv_guard=True)``
+    sweep exists to catch: the guard must fail exactly the owning
+    sequence typed (:class:`~..serving.errors.KVCorruption`) and scrub
+    the page, leaving co-resident and prefix-sharing sequences
+    bitwise-intact.  The corruption lands on the scheduler's OWN worker
+    thread, pre-dispatch (the serve-fault choke point), into the tail
+    page the imminent decode step appends to — a privately held
+    (refcount-1) page, never a shared prefix page, mirroring a real
+    in-place write gone bad.  ``seq`` targets one request's sequence
+    (default: the first slot decoding with ``after_tokens`` accepted).
+    Fires once; yields a one-item list with the corruption count."""
+    import threading
+
+    fired = [0]
+    need = int(after_tokens)
+
+    def hook(requests):
+        if fired[0] \
+                or threading.current_thread().name != scheduler._worker.name:
+            return
+        import jax.numpy as jnp
+
+        ps = scheduler.config.page_size
+        for slot in scheduler._slots:
+            if slot is None or slot.prefilling:
+                continue
+            if seq is not None and slot.req.seq != seq:
+                continue
+            if len(slot.generated) < need:
+                continue
+            page = int(slot.pages[slot.kv_len // ps])
+            if page == 0:
+                continue
+            cache = scheduler._cache
+            cache.k_pool = cache.k_pool.at[:, page, 0, 0, 0].set(jnp.nan)
+            fired[0] += 1
+            return
+
+    with _serve_fault_installed(hook):
+        yield fired
 
