@@ -78,12 +78,14 @@ from .registry import (
     get_telemetry,
     histogram,
     inc,
+    labeled_name,
     observe,
     observe_span,
     record_span,
     remove_sink,
     reset,
     span,
+    split_labels,
     timed,
     timer,
 )
@@ -112,6 +114,8 @@ __all__ = [
     "gauge",
     "timer",
     "histogram",
+    "labeled_name",
+    "split_labels",
     "inc",
     "observe",
     "span",

@@ -30,7 +30,7 @@ import json
 import re
 import threading
 
-from .registry import get_telemetry
+from .registry import get_telemetry, split_labels
 
 __all__ = ["render_prometheus", "MetricsServer", "prometheus_name",
            "parse_prometheus"]
@@ -128,51 +128,84 @@ def _fmt(v):
     return repr(float(v))
 
 
+def _families(cells, prefix, suffix=""):
+    """Group registry cells into Prometheus families: labeled cells
+    (registry key ``name{k="v",...}``) collapse onto their base name's
+    family, so one ``# TYPE`` line covers the unlabeled aggregate AND
+    every label combination — a compliant scraper rejects duplicate TYPE
+    declarations, which is exactly what per-cell TYPE lines would emit
+    once tenant/model labels exist."""
+    fams = {}
+    for key, cell in cells.items():
+        base, labels = split_labels(key)
+        fams.setdefault(prometheus_name(base, prefix) + suffix, []).append(
+            (labels, cell))
+    return fams
+
+
+def _merge_le(labels, le):
+    """Bucket sample labels: the cell's own labels plus ``le``."""
+    if not labels:
+        return '{le="%s"}' % le
+    return '%s,le="%s"}' % (labels[:-1], le)
+
+
 def render_prometheus(telemetry=None, prefix="paddle_tpu_"):
     """Render every registry cell as Prometheus text exposition.
 
     Gauges holding non-numeric values (None before first write, string
     states) are skipped — the exposition format is numbers only; string
     state machines already publish numeric code gauges
-    (``serving.breaker_state``)."""
+    (``serving.breaker_state``).  Labeled cells (``name{k="v"}``
+    registry keys, e.g. the tenant/model-tagged serving counters)
+    render as label-suffixed samples under ONE family TYPE line,
+    alongside the unlabeled aggregate sample when both exist."""
     tel = telemetry if telemetry is not None else get_telemetry()
     lines = []
-    for name, c in sorted(tel.counters().items()):
-        m = prometheus_name(name, prefix)
-        lines.append("# TYPE %s_total counter" % m)
-        lines.append("%s_total %s" % (m, _fmt(c.value)))
-    for name, g in sorted(tel.gauges().items()):
-        v = g.value
-        if isinstance(v, bool) or not isinstance(v, (int, float)):
-            continue
-        m = prometheus_name(name, prefix)
-        lines.append("# TYPE %s gauge" % m)
-        lines.append("%s %s" % (m, _fmt(v)))
+    for m, group in sorted(_families(tel.counters(), prefix,
+                                     "_total").items()):
+        lines.append("# TYPE %s counter" % m)
+        for labels, c in sorted(group):
+            lines.append("%s%s %s" % (m, labels, _fmt(c.value)))
+    for m, group in sorted(_families(tel.gauges(), prefix).items()):
+        out = []
+        for labels, g in sorted(group):
+            v = g.value
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            out.append("%s%s %s" % (m, labels, _fmt(v)))
+        if out:
+            lines.append("# TYPE %s gauge" % m)
+            lines.extend(out)
     hists = tel.histograms()
-    for name, t in sorted(tel.timers().items()):
-        if name in hists:
+    hist_fams = _families(hists, prefix, "_seconds")
+    timers = {key: t for key, t in tel.timers().items() if key not in hists}
+    for m, group in sorted(_families(timers, prefix, "_seconds").items()):
+        if m in hist_fams:
             # serving wires a Timer AND a Histogram onto the same name
             # (e.g. serving.queue_wait); both would render as
             # <name>_seconds with conflicting TYPE lines and duplicate
             # _sum/_count samples — a Prometheus parser rejects the
             # whole scrape.  The histogram subsumes the summary (same
-            # _sum/_count plus the bucket ladder), so it wins.
+            # _sum/_count plus the bucket ladder), so it wins — per
+            # exact cell key AND per family name.
             continue
-        m = prometheus_name(name, prefix) + "_seconds"
-        stats = t.stats()
-        count, total = (0, 0.0) if stats is None else (stats[0], stats[1])
         lines.append("# TYPE %s summary" % m)
-        lines.append("%s_count %s" % (m, _fmt(count)))
-        lines.append("%s_sum %s" % (m, _fmt(total)))
-    for name, h in sorted(hists.items()):
-        m = prometheus_name(name, prefix) + "_seconds"
-        snap = h.snapshot()
+        for labels, t in sorted(group):
+            stats = t.stats()
+            count, total = (0, 0.0) if stats is None else (stats[0],
+                                                           stats[1])
+            lines.append("%s_count%s %s" % (m, labels, _fmt(count)))
+            lines.append("%s_sum%s %s" % (m, labels, _fmt(total)))
+    for m, group in sorted(hist_fams.items()):
         lines.append("# TYPE %s histogram" % m)
-        for le, cum in snap.cumulative():
-            lines.append('%s_bucket{le="%s"} %s'
-                         % (m, _fmt(le), _fmt(cum)))
-        lines.append("%s_sum %s" % (m, _fmt(snap.sum)))
-        lines.append("%s_count %s" % (m, _fmt(snap.count)))
+        for labels, h in sorted(group):
+            snap = h.snapshot()
+            for le, cum in snap.cumulative():
+                lines.append('%s_bucket%s %s'
+                             % (m, _merge_le(labels, _fmt(le)), _fmt(cum)))
+            lines.append("%s_sum%s %s" % (m, labels, _fmt(snap.sum)))
+            lines.append("%s_count%s %s" % (m, labels, _fmt(snap.count)))
     return "\n".join(lines) + "\n"
 
 

@@ -33,6 +33,8 @@ __all__ = [
     "Timer",
     "Histogram",
     "histogram",
+    "labeled_name",
+    "split_labels",
     "Telemetry",
     "get_telemetry",
     "enabled",
@@ -54,6 +56,39 @@ __all__ = [
 
 def _env_enabled():
     return os.environ.get("PADDLE_TPU_TELEMETRY", "1") != "0"
+
+
+def _safe_label_value(value):
+    """Label values land verbatim inside ``name{k="v"}`` registry keys
+    (and from there in the Prometheus exposition), so characters that
+    would break the sample grammar — quotes, backslashes, newlines —
+    are replaced instead of escaped: the strict parser we gate the
+    exposition with reads no escape sequences."""
+    s = str(value)
+    return "".join(c if (c.isalnum() or c in "_.:/-@ ") else "_" for c in s)
+
+
+def labeled_name(name, labels=None):
+    """Canonical registry key for a labeled metric cell:
+    ``name{k="v",...}`` with keys sorted, or ``name`` unchanged when
+    ``labels`` is empty/None.  The same (name, labels) pair always maps
+    to the same key, so cached handles and registry lookups agree."""
+    if not labels:
+        return name
+    parts = ['%s="%s"' % (k, _safe_label_value(labels[k]))
+             for k in sorted(labels)]
+    return "%s{%s}" % (name, ",".join(parts))
+
+
+def split_labels(key):
+    """Inverse of :func:`labeled_name` as far as rendering needs:
+    ``(base_name, label_suffix)`` where the suffix is ``""`` or the
+    verbatim ``{k="v",...}`` part.  The exporter groups cells into one
+    Prometheus family per base name with this."""
+    i = key.find("{")
+    if i < 0:
+        return key, ""
+    return key[:i], key[i:]
 
 
 class Counter:
@@ -247,28 +282,40 @@ class Telemetry:
         self.recording = bool(self._record_sinks)
 
     # -- metrics -------------------------------------------------------------
-    def counter(self, name) -> Counter:
+    # ``labels`` (a {key: value} dict) keys a DISTINCT cell per label
+    # combination under one logical family: the registry key is
+    # ``labeled_name(name, labels)``, reset(prefix=name) still matches
+    # every labeled cell (the key starts with the base name), and the
+    # exporter regroups the cells into one Prometheus family with
+    # per-sample label suffixes.  Unlabeled and labeled cells of the
+    # same name coexist (the unlabeled one is the cross-label
+    # aggregate the SLO monitor windows over).
+    def counter(self, name, labels=None) -> Counter:
+        name = labeled_name(name, labels)
         c = self._counters.get(name)
         if c is None:
             with self._lock:
                 c = self._counters.setdefault(name, Counter(name))
         return c
 
-    def gauge(self, name) -> Gauge:
+    def gauge(self, name, labels=None) -> Gauge:
+        name = labeled_name(name, labels)
         g = self._gauges.get(name)
         if g is None:
             with self._lock:
                 g = self._gauges.setdefault(name, Gauge(name))
         return g
 
-    def timer(self, name) -> Timer:
+    def timer(self, name, labels=None) -> Timer:
+        name = labeled_name(name, labels)
         t = self._timers.get(name)
         if t is None:
             with self._lock:
                 t = self._timers.setdefault(name, Timer(name))
         return t
 
-    def histogram(self, name) -> Histogram:
+    def histogram(self, name, labels=None) -> Histogram:
+        name = labeled_name(name, labels)
         h = self._histograms.get(name)
         if h is None:
             with self._lock:
@@ -406,20 +453,20 @@ def enabled():
     return _global.enabled
 
 
-def counter(name) -> Counter:
-    return _global.counter(name)
+def counter(name, labels=None) -> Counter:
+    return _global.counter(name, labels)
 
 
-def gauge(name) -> Gauge:
-    return _global.gauge(name)
+def gauge(name, labels=None) -> Gauge:
+    return _global.gauge(name, labels)
 
 
-def timer(name) -> Timer:
-    return _global.timer(name)
+def timer(name, labels=None) -> Timer:
+    return _global.timer(name, labels)
 
 
-def histogram(name) -> Histogram:
-    return _global.histogram(name)
+def histogram(name, labels=None) -> Histogram:
+    return _global.histogram(name, labels)
 
 
 def inc(name, n=1):

@@ -112,6 +112,7 @@ from .errors import (
     ServingError,
     ServingOverloaded,
     ServingQueueFull,
+    ServingQuotaExceeded,
     ServingTimeout,
 )
 from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
@@ -119,10 +120,14 @@ from .model_store import LoadedModel, ModelStore
 from .replica_pool import ReplicaPool
 from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
 from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
+from .router import ModelRouter, RoutedRequest, TenantQuota
 
 __all__ = [
     "InferenceEngine",
     "ReplicaPool",
+    "ModelRouter",
+    "TenantQuota",
+    "RoutedRequest",
     "BatchExecutor",
     "DynamicBatcher",
     "CompletionTracker",
@@ -146,6 +151,7 @@ __all__ = [
     "ServingTimeout",
     "ServingQueueFull",
     "ServingOverloaded",
+    "ServingQuotaExceeded",
     "ServingDegraded",
     "ServingClosed",
     "ServingCancelled",

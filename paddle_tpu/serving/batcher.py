@@ -122,11 +122,20 @@ class DynamicBatcher:
     wrong; a caller that wants a gated worker to participate in its
     drain must open the gate first (the pool's ``stop`` force-opens
     every gate before it drains the shared watermark).
+
+    ``service_key``: consumer-group key stamped onto every
+    ``note_service`` sample (``RequestQueue.register_consumers``), so a
+    queue shared across pools can keep per-group rate EMAs.
+    ``owns_queue=False`` marks the queue as SHARED with consumers
+    outside this batcher's owner (another pool): stop() then never
+    ``drain_remaining``s the leftovers — they belong to someone else —
+    and whoever coordinates the sharing (the router) fails them after
+    every consumer is stopped.
     """
 
     def __init__(self, queue, execute, max_batch_size, batch_timeout_s,
                  name="paddle-tpu-serving-batcher", tracker=None, gate=None,
-                 label="batcher"):
+                 label="batcher", service_key=None, owns_queue=True):
         self._queue = queue
         self._execute = execute
         self.max_batch_size = int(max_batch_size)
@@ -134,6 +143,8 @@ class DynamicBatcher:
         self._drain = True
         self._tracker = tracker if tracker is not None else CompletionTracker()
         self._gate = gate
+        self._service_key = service_key
+        self._owns_queue = bool(owns_queue)
         self.batches = 0
         self._inflight = None          # batch being dispatched right now
         # thread lifecycle (single-use Thread re-arming, life lock
@@ -276,7 +287,10 @@ class DynamicBatcher:
             elapsed = time.perf_counter() - now
             note = getattr(self._queue, "note_service", None)
             if note is not None:
-                note(rows, elapsed)
+                if self._service_key is not None:
+                    note(rows, elapsed, self._service_key)
+                else:
+                    note(rows, elapsed)
             if spans:
                 for r in batch:
                     if r.trace is not None:
@@ -301,6 +315,12 @@ class DynamicBatcher:
         self._drain = bool(drain)
         self._worker.request_stop()
         stopped = self._worker.join(timeout)
+        if not self._owns_queue:
+            # shared queue: the leftovers belong to the OTHER pools
+            # still draining it — failing them here would shed requests
+            # a live sibling was about to answer.  The sharing
+            # coordinator drains typed once every consumer is stopped.
+            return stopped
         if self._queue.depth() and (stopped or timeout is not None):
             # nothing will ever pop these (dead/wedged worker): fail fast.
             # A wedged-but-alive worker popping concurrently is safe —

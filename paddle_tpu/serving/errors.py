@@ -3,6 +3,9 @@
 Every failure a client of :class:`~paddle_tpu.serving.InferenceEngine`
 can see maps to one of these, so callers distinguish "shed this request"
 (``ServingQueueFull`` / ``ServingOverloaded`` — retry elsewhere / later),
+"this tenant is over budget" (``ServingQuotaExceeded`` — the router's
+per-tenant token bucket or in-flight cap; pace the tenant, the server
+is fine),
 "the request ran out of time" (``ServingTimeout`` — its deadline expired
 in queue or while waiting), "the engine is sick" (``ServingDegraded`` —
 circuit breaker open or worker dead, fast-fail until it heals), "the
@@ -22,6 +25,7 @@ __all__ = [
     "ServingTimeout",
     "ServingQueueFull",
     "ServingOverloaded",
+    "ServingQuotaExceeded",
     "ServingDegraded",
     "ServingClosed",
     "ServingCancelled",
@@ -51,6 +55,15 @@ class ServingOverloaded(ServingError):
     service rate, the request's deadline cannot be met — rejecting it
     NOW (instead of letting it expire in queue) is what lets the caller
     fail over while it still has time.  The request was NOT admitted."""
+
+
+class ServingQuotaExceeded(ServingError):
+    """The TENANT's admission budget is spent, not the server's: the
+    request's tenant is over its token-bucket rows/s rate or its
+    max-in-flight cap (``ModelRouter.set_quota``).  The request was NOT
+    admitted; unlike ``ServingOverloaded`` the right reaction is
+    client-side pacing (back off this tenant's traffic), not failover —
+    the same server is happily serving other tenants."""
 
 
 class ServingDegraded(ServingError):

@@ -95,6 +95,7 @@ a request's trace tree names the replica that served it.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 
@@ -128,6 +129,10 @@ _decode_replays = _obs.counter("serving.decode.replays")
 #: serving.replica.state_<i> gauge codes
 REPLICA_STATES = {"parked": 0, "serving": 1, "draining": 2, "ejected": 3,
                   "dead": 4}
+
+# unique consumer-group keys for pools sharing one RequestQueue: two
+# pools of the SAME deployment must still keep distinct rate EMAs
+_POOL_IDS = itertools.count()
 
 
 class _DevicePlace(_core.Place):
@@ -188,7 +193,9 @@ class _Replica:
             pool.batch_timeout_ms / 1e3,
             name="paddle-tpu-serving-replica%d" % index,
             tracker=pool._tracker, gate=self._gate,
-            label="replica%d" % index)
+            label="replica%d" % index,
+            service_key=pool._consumer_key,
+            owns_queue=pool._owns_queue)
         self._inflight_gauge = _obs.gauge(
             "serving.replica.inflight_rows_%d" % index)
         self._state_gauge = _obs.gauge("serving.replica.state_%d" % index)
@@ -365,6 +372,14 @@ class ReplicaPool:
         replay-on-death, and per-replica decode breakers (see the
         module docstring).  ``model_dir=None`` builds a decode-only
         pool (``predict`` then rejects typed).
+    queue / tracker: share ONE admission ``RequestQueue`` and
+        ``CompletionTracker`` with other pools (the router's cross-pool
+        refactor): the pool registers itself as a consumer group for
+        the shed estimator and never closes/drains a queue it does not
+        own — the sharing coordinator does, after stopping every pool.
+    model_label: deployment label stamped on every admitted request —
+        keys the tenant/model-labeled per-class telemetry and this
+        pool's consumer-group rate EMA.
     """
 
     def __init__(self, model_dir, replicas=None, devices=None,
@@ -377,7 +392,8 @@ class ReplicaPool:
                  breaker_cooldown_s=1.0, supervise=True,
                  worker_max_restarts=3, supervisor_interval_s=0.1,
                  scale_down_after_s=5.0, decode_model=None,
-                 decode_config=None):
+                 decode_config=None, queue=None, tracker=None,
+                 model_label=None):
         import jax
 
         buckets = sorted(set(int(b) for b in batch_buckets))
@@ -407,9 +423,19 @@ class ReplicaPool:
                              % (self.min_replicas, self.max_replicas))
         self.scale_down_after_s = float(scale_down_after_s)
         self._state = "loading"
-        self._queue = RequestQueue(queue_capacity,
-                                   class_capacity=class_capacity)
-        self._tracker = CompletionTracker()
+        # queue=/tracker=: share ONE admission queue + completion
+        # watermark across pools (the DecodeScheduler's pool-mode
+        # pattern lifted a level): the pool never closes or drains a
+        # queue it does not own — the sharing coordinator (the router,
+        # or the test harness) does, once every sharing pool stopped.
+        self.model_label = model_label
+        self._owns_queue = queue is None
+        self._queue = queue if queue is not None else RequestQueue(
+            queue_capacity, class_capacity=class_capacity)
+        self._tracker = tracker if tracker is not None \
+            else CompletionTracker()
+        self._consumer_key = "%s#%d" % (model_label or "pool",
+                                        next(_POOL_IDS))
         self._swap_lock = threading.Lock()
         self._scale_lock = threading.Lock()
         self._below_since = None      # scale-down hysteresis window start
@@ -434,8 +460,16 @@ class ReplicaPool:
             rep.active = rep.index < active0
         # LIVE consumer count for the deadline-shed estimator: breaker
         # ejects, autoscale parks, worker deaths/revivals all reflect at
-        # the next admission estimate with no bookkeeping at each flip
-        self._queue.set_parallelism(lambda: max(1, len(self._ready())))
+        # the next admission estimate with no bookkeeping at each flip.
+        # Registered as a consumer GROUP (keyed by this pool) so a
+        # shared queue sums each pool's count x its own rate EMA; the
+        # legacy parallelism callable stays as the all-groups-cold
+        # fallback — and the sole estimator for a pool-owned queue
+        # before the first keyed sample lands.
+        self._queue.register_consumers(self._consumer_key,
+                                       lambda: len(self._ready()))
+        if self._owns_queue:
+            self._queue.set_parallelism(lambda: max(1, len(self._ready())))
         self._decode_enabled = decode_model is not None
         self._decode_config = None
         self._decode_queue = None
@@ -538,13 +572,21 @@ class ReplicaPool:
         (every replica participates in the drain — gates open, including
         parked ones); new requests are rejected with ``ServingClosed``
         from the moment the stop begins.  Serializes with an in-flight
-        rolling swap on the swap lock."""
+        rolling swap on the swap lock.
+
+        A pool built on a SHARED queue (``queue=``) stops only its own
+        consumers: it neither closes nor drains the queue (the sharing
+        coordinator does, once every pool is stopped), and its drain
+        waits on the shared watermark only via its own batchers' exit
+        condition — close the shared queue BEFORE stopping the last
+        pool or a drain-stop can block on sibling traffic."""
         with self._swap_lock:
             if self._state == "stopped":
                 return
             self._state = "stopped"
             self.stop_autoscaler()
-            self._queue.close()
+            if self._owns_queue:
+                self._queue.close()
             if self._decode_queue is not None:
                 self._decode_queue.close()
             for rep in self._replicas:
@@ -553,9 +595,10 @@ class ReplicaPool:
                 rep.active = True
                 rep.draining = False
                 rep.force_serve = True
-            if drain and (self._supervisor is not None
-                          or any(r.batcher.alive
-                                 for r in self._replicas)):
+            if drain and self._owns_queue \
+                    and (self._supervisor is not None
+                         or any(r.batcher.alive
+                                for r in self._replicas)):
                 # drain POOL-level first, against the shared watermark:
                 # per-batcher stop fails queue leftovers once ITS worker
                 # is gone, which would shed requests the other replicas
@@ -586,6 +629,7 @@ class ReplicaPool:
             if self._metrics_server is not None:
                 self._metrics_server.stop()
                 self._metrics_server = None
+            self._queue.unregister_consumers(self._consumer_key)
             self._publish()
 
     def __enter__(self):
@@ -622,6 +666,10 @@ class ReplicaPool:
         replica can ever serve it — one dead replica must not fail
         requests its siblings will happily answer."""
         if any(r.batcher.alive and not r.failed for r in self._replicas):
+            return
+        if not self._owns_queue:
+            # a sibling pool may still drain the shared queue; only the
+            # sharing coordinator may declare it globally unservable
             return
         self._queue.drain_remaining(
             lambda r: ServingDegraded(
@@ -862,11 +910,15 @@ class ReplicaPool:
             rep.publish()
 
     # -- request admission ---------------------------------------------------
-    def predict_async(self, feed, deadline_ms=None, priority=None):
+    def predict_async(self, feed, deadline_ms=None, priority=None,
+                      tenant=None):
         """Admit one request into the SHARED queue; whichever ready
         replica claims it serves it.  Same error contract as the
         engine's ``predict_async``; ``ServingDegraded`` only when no
-        replica could ever serve it (all dead past budget or ejected)."""
+        replica could ever serve it (all dead past budget or ejected).
+        ``tenant`` (plus the pool's ``model_label``) stamps the request
+        for the labeled per-class accounting — quota enforcement itself
+        lives in the router, not here."""
         if self._state == "stopped":
             raise ServingClosed("replica pool is stopped")
         if self._state == "loading":
@@ -886,17 +938,20 @@ class ReplicaPool:
             else self.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
         req = self._queue.put(
-            Request(arrays, rows, deadline=deadline, priority=priority))
+            Request(arrays, rows, deadline=deadline, priority=priority,
+                    tenant=tenant, model=self.model_label))
         _requests.inc()
         return req
 
-    def predict(self, feed, deadline_ms=None, priority=None, timeout=None):
+    def predict(self, feed, deadline_ms=None, priority=None, timeout=None,
+                tenant=None):
         return self.predict_async(
-            feed, deadline_ms=deadline_ms, priority=priority).result(
-            timeout=timeout)
+            feed, deadline_ms=deadline_ms, priority=priority,
+            tenant=tenant).result(timeout=timeout)
 
     def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None,
-                       priority=None, temperature=None, seed=None):
+                       priority=None, temperature=None, seed=None,
+                       tenant=None):
         """Admit one generation into the SHARED decode queue; whichever
         least-loaded decode-ready replica claims it serves it — and if
         that replica dies mid-decode, the journal replays the sequence
@@ -951,20 +1006,25 @@ class ReplicaPool:
         ms = deadline_ms if deadline_ms is not None \
             else dcfg.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
-        req = self._decode_queue.put(
-            GenerateRequest(tokens, n_new, deadline=deadline,
-                            priority=priority, temperature=temperature,
-                            seed=seed))
+        greq = GenerateRequest(tokens, n_new, deadline=deadline,
+                               priority=priority, temperature=temperature,
+                               seed=seed)
+        # stamp the accounting labels BEFORE put: the admission raise
+        # paths read them for the labeled rejected counters
+        greq.tenant = tenant
+        greq.model = self.model_label
+        req = self._decode_queue.put(greq)
         _decode_requests.inc()
         return req
 
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
-                 timeout=None, priority=None, temperature=None, seed=None):
+                 timeout=None, priority=None, temperature=None, seed=None,
+                 tenant=None):
         """Synchronous generate: the generated int32 token ids."""
         return self.generate_async(
             prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
             priority=priority, temperature=temperature,
-            seed=seed).result(timeout=timeout)
+            seed=seed, tenant=tenant).result(timeout=timeout)
 
     def drain_decode(self, timeout=None):
         """Block until no generation is queued, parked, or decoding

@@ -83,6 +83,41 @@ for _cls in ("interactive", "batch", "best_effort"):
     _latency_hists[_cls] = _obs.histogram("serving.request_latency_%s" % _cls)
 del _cls
 
+# Labeled siblings of the per-class cells above, keyed (kind, name,
+# model, tenant): requests stamped with a tenant and/or model (the
+# router / a labeled pool) ALSO tick ``serving.done_<cls>{model=,
+# tenant=}`` etc., so co-hosted deployments stop cross-contaminating
+# one process-wide cell.  The unlabeled aggregates keep counting — the
+# SLO monitor windows those.  Cached here because the terminal-outcome
+# funnel is hot (one dict probe vs a registry lock + key build).
+_labeled_cells = {}
+
+
+def _labeled_cell(kind, name, model, tenant):
+    key = (kind, name, model, tenant)
+    cell = _labeled_cells.get(key)
+    if cell is None:
+        labels = {}
+        if model is not None:
+            labels["model"] = model
+        if tenant is not None:
+            labels["tenant"] = tenant
+        make = _obs.histogram if kind == "h" else _obs.counter
+        cell = _labeled_cells[key] = make(name, labels=labels)
+    return cell
+
+
+def note_rejected(cls, model=None, tenant=None):
+    """Tick the per-class rejection counter (plus its tenant/model
+    labeled sibling when either label is present).  Shared by the
+    queue's admission raise paths and the router's quota gate, so
+    every shed — capacity, deadline, or quota — lands on ONE family."""
+    if cls not in _rejected_counters:
+        cls = DEFAULT_PRIORITY
+    _rejected_counters[cls].inc()
+    if model is not None or tenant is not None:
+        _labeled_cell("c", "serving.rejected_%s" % cls, model, tenant).inc()
+
 
 class Request:
     """One admitted prediction request; doubles as the caller's future.
@@ -99,16 +134,20 @@ class Request:
     """
 
     __slots__ = ("feed", "rows", "seq", "deadline", "priority", "trace",
-                 "enqueue_wall", "enqueue_ts", "dispatch_ts", "done_ts",
-                 "_event", "_result", "_error", "_term_lock")
+                 "tenant", "model", "enqueue_wall", "enqueue_ts",
+                 "dispatch_ts", "done_ts", "_event", "_result", "_error",
+                 "_term_lock", "_done_cbs")
 
-    def __init__(self, feed, rows, deadline=None, priority=None, trace=None):
+    def __init__(self, feed, rows, deadline=None, priority=None, trace=None,
+                 tenant=None, model=None):
         self.feed = feed
         self.rows = int(rows)
         self.seq = None              # assigned by RequestQueue.put
         self.deadline = deadline     # absolute time.perf_counter() instant
         self.priority = priority or DEFAULT_PRIORITY
         self.trace = trace           # TraceContext root; minted at admission
+        self.tenant = tenant         # multi-tenant accounting label
+        self.model = model           # owning deployment's label
         self.enqueue_wall = None     # wall clock, for trace spans
         self.enqueue_ts = None       # perf_counter, for queue-wait timing
         self.dispatch_ts = None
@@ -120,6 +159,7 @@ class Request:
         # fail() (a revived worker finishing a request the same instant
         # stop()'s drain fails it) must account exactly one outcome
         self._term_lock = threading.Lock()
+        self._done_cbs = None        # add_done_callback list (lazy)
 
     # -- batcher side --------------------------------------------------------
     def expired(self, now=None):
@@ -135,6 +175,8 @@ class Request:
             self.done_ts = time.perf_counter()
             self._note_done(ok=True)
             self._event.set()
+            cbs, self._done_cbs = self._done_cbs, None
+        self._run_done_cbs(cbs)
 
     def fail(self, exc):
         with self._term_lock:
@@ -144,6 +186,30 @@ class Request:
             self.done_ts = time.perf_counter()
             self._note_done(ok=False)
             self._event.set()
+            cbs, self._done_cbs = self._done_cbs, None
+        self._run_done_cbs(cbs)
+
+    def add_done_callback(self, fn):
+        """Run ``fn(self)`` once this request reaches its terminal
+        outcome (answered OR failed), from the completing thread —
+        immediately if it already has.  The router's per-tenant
+        in-flight accounting hangs off this; callbacks run OUTSIDE the
+        terminal lock and their exceptions are swallowed (a broken
+        observer must not lose the completion)."""
+        with self._term_lock:
+            if not self._event.is_set():
+                if self._done_cbs is None:
+                    self._done_cbs = []
+                self._done_cbs.append(fn)
+                return
+        self._run_done_cbs((fn,))
+
+    def _run_done_cbs(self, cbs):
+        for fn in cbs or ():
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — observer must not break
+                pass           # the completion path
 
     def _note_done(self, ok):
         """Terminal-outcome accounting: per-class done/ok/deadline-met
@@ -153,14 +219,27 @@ class Request:
         cls = self.priority if self.priority in _done_counters \
             else DEFAULT_PRIORITY
         _done_counters[cls].inc()
+        labeled = self.model is not None or self.tenant is not None
+        if labeled:
+            _labeled_cell("c", "serving.done_%s" % cls, self.model,
+                          self.tenant).inc()
         latency = (self.done_ts - self.enqueue_ts
                    if self.enqueue_ts is not None else None)
         if ok:
             _done_ok_counters[cls].inc()
+            if labeled:
+                _labeled_cell("c", "serving.done_ok_%s" % cls, self.model,
+                              self.tenant).inc()
             if latency is not None:
                 _latency_hists[cls].observe(latency)
+                if labeled:
+                    _labeled_cell("h", "serving.request_latency_%s" % cls,
+                                  self.model, self.tenant).observe(latency)
             if self.deadline is None or self.done_ts <= self.deadline:
                 _met_counters[cls].inc()
+                if labeled:
+                    _labeled_cell("c", "serving.deadline_met_%s" % cls,
+                                  self.model, self.tenant).inc()
         tel = _obs.get_telemetry()
         if (tel.span_active() and self.trace is not None
                 and self.enqueue_wall is not None):
@@ -249,6 +328,8 @@ class RequestQueue:
         self._closed = False
         self._service_rate = None    # EMA rows/second, None until warm
         self._parallelism = 1        # concurrent consumers (replica pool)
+        self._service_rates = {}     # per consumer-group EMAs (keyed)
+        self._consumer_groups = {}   # group key -> live count (int/callable)
         self._depth_gauge = depth_gauge if depth_gauge is not None else _queue_depth
         self._full_counter = (full_counter if full_counter is not None
                               else _queue_full)
@@ -264,11 +345,15 @@ class RequestQueue:
         # via engine.health().
 
     # -- service-rate estimate (deadline-aware admission) --------------------
-    def note_service(self, rows, seconds):
+    def note_service(self, rows, seconds, key=None):
         """Record one dispatch (``rows`` served in ``seconds`` of worker
         time) into the service-rate EMA the admission check divides by.
         Failed dispatches count too: they occupied the worker, which is
-        what a queued request actually waits on."""
+        what a queued request actually waits on.  ``key`` (a consumer
+        GROUP — one pool among several sharing this queue) additionally
+        feeds that group's own EMA, so the admission estimate can weight
+        each group by its own measured speed instead of smearing a busy
+        neighbor's rate across everyone (see :meth:`register_consumers`)."""
         if seconds <= 0 or rows <= 0:
             return
         rate = rows / seconds
@@ -276,6 +361,10 @@ class RequestQueue:
             self._service_rate = (
                 rate if self._service_rate is None
                 else 0.75 * self._service_rate + 0.25 * rate)
+            if key is not None:
+                prev = self._service_rates.get(key)
+                self._service_rates[key] = (
+                    rate if prev is None else 0.75 * prev + 0.25 * rate)
 
     @property
     def service_rate(self):
@@ -310,22 +399,74 @@ class RequestQueue:
                 p = 1          # a health-probe fault; fall conservative
         return max(1, int(p))
 
+    def register_consumers(self, key, count):
+        """Register one consumer GROUP draining this queue — a replica
+        pool among several sharing it.  ``count`` is an int or a
+        callable returning the group's LIVE consumer count (its ready
+        replicas).  With groups registered, the deadline-shed admission
+        estimate drains at ``sum_k(count_k * rate_k)`` — each group
+        weighted by its OWN per-key EMA (:meth:`note_service` with
+        ``key=``) — instead of one process-wide ``rate * parallelism``
+        product.  That is the multi-pool fix: a busy neighbor pool's
+        slower (or faster) dispatches no longer inflate or mask another
+        deployment's shed decisions, and a group that parks all its
+        consumers stops counting toward the drain rate entirely.  A
+        cold group (no keyed sample yet) borrows the aggregate EMA."""
+        with self._lock:
+            self._consumer_groups[key] = count
+
+    def unregister_consumers(self, key):
+        """Remove a consumer group (pool stopped) and its rate EMA."""
+        with self._lock:
+            self._consumer_groups.pop(key, None)
+            self._service_rates.pop(key, None)
+
+    def _drain_rate_locked(self):
+        """Rows/second the live consumer set drains this queue at, or
+        None while the estimator is cold (admission never sheds on no
+        data).  Group-aware when groups are registered; otherwise the
+        legacy single-rotation product ``service_rate * parallelism``."""
+        if self._consumer_groups:
+            total = 0.0
+            for key, count in self._consumer_groups.items():
+                n = count
+                if callable(n):
+                    try:
+                        n = n()
+                    except Exception:  # noqa: BLE001 — a health-probe
+                        n = 0          # fault must not distort the sum
+                n = max(0, int(n))
+                if not n:
+                    continue
+                rate = self._service_rates.get(key) or self._service_rate
+                if rate:
+                    total += n * rate
+            if total > 0:
+                return total
+            # every group cold or parked: fall through to the legacy
+            # estimate (conservative — better one stale aggregate than
+            # "infinite wait" failing every deadline request)
+        if not self._service_rate:
+            return None
+        return self._service_rate * self._parallelism_locked()
+
     def estimated_wait_s(self, priority=DEFAULT_PRIORITY):
         """Expected queue wait for a request admitted NOW at ``priority``:
         rows queued at the same or higher priority over the measured
-        aggregate service rate.  None while the estimator is cold."""
+        aggregate drain rate.  None while the estimator is cold."""
         with self._lock:
             return self._estimated_wait_locked(priority)
 
     def _estimated_wait_locked(self, priority):
-        if not self._service_rate:
+        rate = self._drain_rate_locked()
+        if not rate:
             return None
         ahead = 0
         for cls in PRIORITY_CLASSES:
             ahead += self._lane_rows[cls]
             if cls == priority:
                 break
-        return ahead / (self._service_rate * self._parallelism_locked())
+        return ahead / rate
 
     # -- admission -----------------------------------------------------------
     def put(self, request):
@@ -342,13 +483,13 @@ class RequestQueue:
             lane = self._lanes[cls]
             if self._depth >= self.capacity:
                 self._full_counter.inc()
-                _rejected_counters[cls].inc()
+                note_rejected(cls, request.model, request.tenant)
                 raise ServingQueueFull(
                     "request queue at capacity (%d); shed load or retry"
                     % self.capacity)
             if len(lane) >= self.class_capacity[cls]:
                 self._full_counter.inc()
-                _rejected_counters[cls].inc()
+                note_rejected(cls, request.model, request.tenant)
                 raise ServingQueueFull(
                     "priority class %r at capacity (%d); shed load or "
                     "retry" % (cls, self.class_capacity[cls]))
@@ -357,16 +498,14 @@ class RequestQueue:
                 now = time.perf_counter()
                 if est is not None and now + est > request.deadline:
                     self._shed_counter.inc()
-                    _rejected_counters[cls].inc()
-                    par = self._parallelism_locked()
+                    note_rejected(cls, request.model, request.tenant)
+                    rate = self._drain_rate_locked() or 0.0
                     raise ServingOverloaded(
                         "deadline %.0fms away but estimated %s-class "
                         "queue wait is %.0fms (%d rows ahead at %.0f "
-                        "rows/s x %d consumers); shed at admission"
+                        "rows/s aggregate drain rate); shed at admission"
                         % (max(0.0, (request.deadline - now)) * 1e3, cls,
-                           est * 1e3,
-                           int(round(est * self._service_rate * par)),
-                           self._service_rate, par))
+                           est * 1e3, int(round(est * rate)), rate))
             self._seq += 1
             request.seq = self._seq
             if request.trace is None:

@@ -1,0 +1,544 @@
+"""Multi-model serving plane units: ModelRouter tenancy + routing
+semantics, the cross-pool RequestQueue/CompletionTracker sharing it
+unlocked, per-consumer-group drain-rate estimation, and the labeled
+telemetry families it renders.  The end-to-end bitwise / quota / canary
+/ cold-tier gate lives in test_router_gate.py
+(tools/check_router.py); these are the unit half.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+from paddle_tpu.serving.request_queue import Request  # noqa: E402
+
+WIDTH = 8
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("router_model") / "m")
+    _save_model(d, seed=5)
+    return d
+
+
+@pytest.fixture(scope="module")
+def model_dir_b(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("router_model_b") / "m")
+    _save_model(d, seed=9)
+    return d
+
+
+def _save_model(dirname, seed):
+    fluid.unique_name.switch()
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[WIDTH], dtype="float32")
+        out = fluid.layers.fc(x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        np.random.seed(seed)
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [out], exe,
+                                      main_program=main)
+    return dirname
+
+
+POOL_KW = dict(batch_buckets=(2, 4), batch_timeout_ms=0.5, warmup=False,
+               supervisor_interval_s=0.05)
+
+
+def _router(**kw):
+    base = dict(POOL_KW)
+    base.update(kw)
+    return serving.ModelRouter(**base)
+
+
+def _x(rows=1, seed=0):
+    return np.random.RandomState(seed).rand(rows, WIDTH).astype("float32")
+
+
+# -- tenant quota ------------------------------------------------------------
+
+class TestTenantQuota:
+    def test_token_bucket_rate(self):
+        q = serving.TenantQuota("t", rows_per_s=100, burst_rows=10)
+        q.acquire(10)                      # bucket drained
+        with pytest.raises(serving.ServingQuotaExceeded):
+            q.acquire(10)                  # nothing refilled yet
+        time.sleep(0.06)                   # ~6 rows refill
+        q.acquire(4)
+        with pytest.raises(serving.ServingQuotaExceeded):
+            q.acquire(10)
+
+    def test_max_inflight_and_release(self):
+        q = serving.TenantQuota("t", max_inflight=2)
+        q.acquire(1)
+        q.acquire(5)                       # no rate limit: rows free
+        with pytest.raises(serving.ServingQuotaExceeded):
+            q.acquire(1)
+        q.release()
+        q.acquire(1)                       # slot freed
+
+    def test_inflight_breach_refunds_rate_tokens(self):
+        q = serving.TenantQuota("t", rows_per_s=1, burst_rows=10,
+                                max_inflight=1)
+        q.acquire(4)
+        with pytest.raises(serving.ServingQuotaExceeded):
+            q.acquire(4)                   # in-flight cap, NOT the bucket
+        q.release()
+        q.acquire(4)                       # those 4 rows were refunded
+        q.release()
+        with pytest.raises(serving.ServingQuotaExceeded):
+            q.acquire(4)                   # now the bucket really is dry
+
+    def test_cancel_refunds_everything(self):
+        q = serving.TenantQuota("t", rows_per_s=1, burst_rows=4,
+                                max_inflight=1)
+        q.acquire(4)
+        q.cancel(4)                        # downstream admission failed
+        q.acquire(4)                       # full refund: rows AND slot
+
+    def test_validation(self):
+        with pytest.raises(serving.ServingError):
+            serving.TenantQuota("t", rows_per_s=0)
+        with pytest.raises(serving.ServingError):
+            serving.TenantQuota("t", max_inflight=0)
+        with pytest.raises(serving.ServingError):
+            serving.TenantQuota("t", slo_class="platinum")
+
+
+# -- router semantics (no pools needed) --------------------------------------
+
+class TestRouterValidation:
+    def test_unknown_deployment_and_version(self, model_dir):
+        r = _router()
+        try:
+            r.deploy("m", model_dir, warm=False)
+            with pytest.raises(serving.ServingError):
+                r.predict_async("nope", {"x": _x()})
+            with pytest.raises(serving.ServingError):
+                r.route("m", {"ghost": 1.0})
+            with pytest.raises(serving.ServingError):
+                r.route("m", {"v1": 0.0})      # nothing routable
+            with pytest.raises(serving.ServingError):
+                r.deploy("m", model_dir)       # duplicate version
+            with pytest.raises(serving.ServingError):
+                r.deploy("bad name!", model_dir)
+            with pytest.raises(serving.ServingError):
+                r.rollback("m")                # no previous routing
+        finally:
+            r.stop()
+
+    def test_stopped_router_rejects(self, model_dir):
+        r = _router()
+        r.deploy("m", model_dir, warm=False)
+        r.stop()
+        with pytest.raises(serving.ServingClosed):
+            r.predict_async("m", {"x": _x()})
+        with pytest.raises(serving.ServingClosed):
+            r.deploy("m2", model_dir)
+
+    def test_default_quota_applies_to_new_tenants(self, model_dir):
+        r = _router(default_quota=dict(rows_per_s=1, burst_rows=1))
+        try:
+            r.deploy("m", model_dir, replicas=1)
+            r.predict("m", {"x": _x()}, tenant="fresh", timeout=30)
+            with pytest.raises(serving.ServingQuotaExceeded):
+                r.predict("m", {"x": _x()}, tenant="fresh", timeout=30)
+            # anonymous (tenant=None) traffic is never quota'd
+            r.predict("m", {"x": _x()}, timeout=30)
+        finally:
+            r.stop()
+
+    def test_slo_class_sets_default_priority(self, model_dir):
+        r = _router()
+        try:
+            r.deploy("m", model_dir, replicas=1)
+            r.set_quota("be", slo_class="best_effort")
+            before = obs.counter("serving.done_best_effort",
+                                 {"model": "m", "tenant": "be"}).value
+            r.predict("m", {"x": _x()}, tenant="be", timeout=30)
+            after = obs.counter("serving.done_best_effort",
+                                {"model": "m", "tenant": "be"}).value
+            assert after == before + 1
+        finally:
+            r.stop()
+
+
+# -- warm/cold tier ----------------------------------------------------------
+
+class TestColdTier:
+    def test_cold_activation_parks_not_drops(self, model_dir):
+        r = _router()
+        try:
+            r.deploy("m", model_dir, replicas=1, warm=False)
+            h = r.health()
+            assert h["deployments"]["m"]["versions"]["v1"]["tier"] == "cold"
+            futs = [r.predict_async("m", {"x": _x(seed=i)})
+                    for i in range(6)]
+            assert all(isinstance(f, serving.RoutedRequest) for f in futs)
+            outs = [f.result(timeout=60) for f in futs]
+            assert all(o[0].shape == (1, 4) for o in outs)
+            h = r.health()
+            assert h["deployments"]["m"]["versions"]["v1"]["tier"] == "warm"
+        finally:
+            r.stop()
+
+    def test_activation_failure_fails_parked_typed(self, tmp_path):
+        r = _router()
+        try:
+            r.deploy("m", str(tmp_path / "no_such_model"), warm=False)
+            fut = r.predict_async("m", {"x": _x()})
+            with pytest.raises(serving.ServingError):
+                fut.result(timeout=60)
+            assert fut.done()
+        finally:
+            r.stop()
+
+    def test_deactivate_then_reactivate(self, model_dir):
+        r = _router()
+        try:
+            r.deploy("m", model_dir, replicas=1)
+            r.predict("m", {"x": _x()}, timeout=30)
+            r.deactivate("m")
+            tier = r.health()["deployments"]["m"]["versions"]["v1"]["tier"]
+            assert tier == "cold"
+            # next request re-activates through the park path
+            out = r.predict("m", {"x": _x()}, timeout=60)
+            assert out[0].shape == (1, 4)
+        finally:
+            r.stop()
+
+    def test_budget_lru_eviction(self, model_dir, model_dir_b):
+        r = _router(replica_budget=1)
+        try:
+            r.deploy("a", model_dir, replicas=1)
+            r.predict("a", {"x": _x()}, timeout=30)
+            # activating b must evict a (the only other warm version)
+            r.deploy("b", model_dir_b, replicas=1)
+            tiers = {n: d["versions"]["v1"]["tier"]
+                     for n, d in r.health()["deployments"].items()}
+            assert tiers == {"a": "cold", "b": "warm"}
+            # an oversized version can never fit: typed, immediately
+            with pytest.raises(serving.ServingError):
+                r.deploy("c", model_dir, replicas=2)
+        finally:
+            r.stop()
+
+    def test_stop_fails_parked_typed(self, model_dir, tmp_path):
+        r = _router()
+        slow = threading.Event()
+        try:
+            r.deploy("m", str(tmp_path / "missing"), warm=False)
+            # park a request, then stop the router before/while the
+            # (failing) activation settles: the future must resolve
+            fut = r.predict_async("m", {"x": _x()})
+        finally:
+            del slow
+            r.stop()
+        with pytest.raises(serving.ServingError):
+            fut.result(timeout=10)
+
+
+# -- canary routing ----------------------------------------------------------
+
+class TestCanary:
+    def test_smooth_wrr_exact_split(self, model_dir, model_dir_b):
+        r = _router()
+        try:
+            r.deploy("m", model_dir, version="v1", replicas=1)
+            r.deploy("m", model_dir_b, version="v2", replicas=1)
+            # second version defaults DARK until route()
+            assert r.health()["deployments"]["m"]["versions"]["v2"][
+                "weight"] == 0.0
+            r.route("m", {"v1": 0.9, "v2": 0.1})
+
+            def count(v):
+                return obs.counter("serving.router.requests",
+                                   {"model": "m", "version": v}).value
+
+            c0 = (count("v1"), count("v2"))
+            futs = [r.predict_async("m", {"x": _x()}) for _ in range(50)]
+            for f in futs:
+                f.result(timeout=60)
+            got = (count("v1") - c0[0], count("v2") - c0[1])
+            assert got == (45, 5), got     # deterministic, not a band
+        finally:
+            r.stop()
+
+    def test_rollback_roundtrip(self, model_dir, model_dir_b):
+        r = _router()
+        try:
+            r.deploy("m", model_dir, version="v1", replicas=1)
+            r.deploy("m", model_dir_b, version="v2", replicas=1, warm=False)
+            r.route("m", {"v1": 0.5, "v2": 0.5})
+            r.rollback("m")                # back to 100% v1
+            w = r.health()["deployments"]["m"]["versions"]
+            assert w["v1"]["weight"] == 1.0 and w["v2"]["weight"] == 0.0
+            r.rollback("m")                # toggles forward again
+            w = r.health()["deployments"]["m"]["versions"]
+            assert w["v1"]["weight"] == 0.5 and w["v2"]["weight"] == 0.5
+        finally:
+            r.stop()
+
+
+# -- cross-pool queue/tracker sharing ----------------------------------------
+
+class TestCrossPoolSharing:
+    """Two ReplicaPools drain ONE RequestQueue and share ONE
+    CompletionTracker — the refactor the router unlocked."""
+
+    def _shared_pools(self, model_dir, model_dir_b=None):
+        q = serving.RequestQueue(capacity=256)
+        t = serving.CompletionTracker()
+        p1 = serving.ReplicaPool(model_dir, replicas=1, queue=q, tracker=t,
+                                 model_label="m", **POOL_KW)
+        p2 = serving.ReplicaPool(model_dir_b or model_dir, replicas=1,
+                                 queue=q, tracker=t, model_label="m",
+                                 **POOL_KW)
+        return q, t, p1, p2
+
+    def test_watermark_exact_across_pools(self, model_dir):
+        q, t, p1, p2 = self._shared_pools(model_dir)
+        try:
+            futs = []
+            for i in range(40):
+                req = Request({"x": _x(seed=i)}, rows=1)
+                q.put(req)
+                futs.append(req)
+            for f in futs:
+                f.result(timeout=60)
+            # the shared watermark is EXACT: contiguous prefix == last
+            # admitted seq once everything resolved, whichever pool
+            # served each request
+            assert t.completed_seq == q.last_seq()
+        finally:
+            q.close()
+            p1.stop()
+            p2.stop()
+
+    def test_both_pools_participate(self, model_dir):
+        q, t, p1, p2 = self._shared_pools(model_dir)
+        try:
+            futs = []
+            for i in range(64):
+                req = Request({"x": _x(seed=i)}, rows=1)
+                q.put(req)
+                futs.append(req)
+            for f in futs:
+                f.result(timeout=60)
+            d1 = sum(s["dispatches"] for s in p1.replica_stats())
+            d2 = sum(s["dispatches"] for s in p2.replica_stats())
+            assert d1 > 0 and d2 > 0, (d1, d2)
+        finally:
+            q.close()
+            p1.stop()
+            p2.stop()
+
+    def test_fifo_per_lane_two_pools(self, model_dir):
+        """Wrap the shared queue's get() with a recording shim: per
+        priority lane, pops happen in admission order even with two
+        pools' batchers racing on the queue."""
+        q, t, p1, p2 = self._shared_pools(model_dir)
+        popped = []
+        rec_lock = threading.Lock()
+        real_get = q.get
+
+        def recording_get(timeout=None, max_rows=None):
+            with rec_lock:          # serialize: order is then exact
+                req = real_get(timeout=timeout, max_rows=max_rows)
+                if req is not None:
+                    popped.append((req.priority, req.seq))
+                return req
+
+        q.get = recording_get
+        try:
+            futs = []
+            for i in range(48):
+                cls = ("interactive", "batch",
+                       "best_effort")[i % 3]
+                req = Request({"x": _x(seed=i)}, rows=1, priority=cls)
+                q.put(req)
+                futs.append(req)
+            for f in futs:
+                f.result(timeout=60)
+            by_lane = {}
+            for cls, seq in popped:
+                by_lane.setdefault(cls, []).append(seq)
+            for cls, seqs in by_lane.items():
+                assert seqs == sorted(seqs), (
+                    "lane %r popped out of admission order: %s"
+                    % (cls, seqs))
+            assert set(by_lane) == {"interactive", "batch", "best_effort"}
+        finally:
+            q.close()
+            p1.stop()
+            p2.stop()
+
+    def test_shared_pool_stop_leaves_queue_open(self, model_dir):
+        """Stopping ONE pool of a shared queue neither closes nor
+        drains it: the sibling keeps serving."""
+        q, t, p1, p2 = self._shared_pools(model_dir)
+        try:
+            p1.stop()
+            assert not q.closed
+            req = Request({"x": _x()}, rows=1)
+            q.put(req)
+            assert req.result(timeout=60)[0].shape == (1, 4)
+        finally:
+            q.close()
+            p2.stop()
+
+
+# -- per-consumer-group drain-rate estimation --------------------------------
+
+class TestConsumerGroupEstimator:
+    def test_estimate_sums_per_group_rates(self):
+        q = serving.RequestQueue(capacity=512)
+        try:
+            q.register_consumers("a", 2)
+            q.register_consumers("b", 1)
+            q.note_service(100, 1.0, key="a")   # 100 rows/s per a-consumer
+            q.note_service(50, 1.0, key="b")    # 50 rows/s per b-consumer
+            for i in range(10):
+                q.put(Request({"x": None}, rows=25))
+            # 250 rows ahead at 2*100 + 1*50 = 250 rows/s aggregate
+            wait = q.estimated_wait_s()
+            assert wait == pytest.approx(1.0, rel=0.05), wait
+        finally:
+            q.close()
+
+    def test_unregister_falls_back_to_global(self):
+        q = serving.RequestQueue(capacity=512)
+        try:
+            q.register_consumers("a", 4)
+            q.note_service(100, 1.0, key="a")
+            q.unregister_consumers("a")
+            q.set_parallelism(1)
+            for i in range(4):
+                q.put(Request({"x": None}, rows=25))
+            # global EMA (fed by the keyed note_service too) x 1 worker
+            wait = q.estimated_wait_s()
+            assert wait == pytest.approx(1.0, rel=0.05), wait
+        finally:
+            q.close()
+
+    def test_group_without_rate_uses_global_ema(self):
+        q = serving.RequestQueue(capacity=512)
+        try:
+            q.note_service(100, 1.0)            # only the global EMA
+            q.register_consumers("cold", 2)     # keyed rate unknown
+            for i in range(4):
+                q.put(Request({"x": None}, rows=50))
+            # 200 rows at 2 consumers x global 100 rows/s
+            wait = q.estimated_wait_s()
+            assert wait == pytest.approx(1.0, rel=0.05), wait
+        finally:
+            q.close()
+
+    def test_admission_shed_uses_group_rates(self):
+        q = serving.RequestQueue(capacity=512)
+        try:
+            q.register_consumers("slow", 1)
+            q.note_service(10, 1.0, key="slow")  # 10 rows/s total
+            q.put(Request({"x": None}, rows=100))
+            # 100 rows ahead = 10s of backlog; a 100ms deadline is
+            # provably unmeetable -> shed AT admission
+            with pytest.raises(serving.ServingOverloaded):
+                q.put(Request({"x": None}, rows=1,
+                              deadline=time.perf_counter() + 0.1))
+        finally:
+            q.close()
+
+
+# -- labeled telemetry families ----------------------------------------------
+
+class TestLabeledFamilies:
+    def test_labeled_and_unlabeled_cells_coexist(self):
+        c_plain = obs.counter("serving.test_fam")
+        c_lab = obs.counter("serving.test_fam",
+                            {"model": "m1", "tenant": "t1"})
+        assert c_plain is not c_lab
+        assert c_lab is obs.counter("serving.test_fam",
+                                    {"tenant": "t1", "model": "m1"})
+
+    def test_labeled_name_sanitizes(self):
+        n = obs.labeled_name("f", {"model": 'a"b\\c'})
+        assert '"' not in n.split("{")[1].replace('="', "", 1) \
+            .replace('"}', "")
+        base, labels = obs.split_labels(n)
+        assert base == "f" and labels.startswith("{")
+
+    def test_prometheus_renders_labeled_families(self):
+        obs.counter("serving.fam_done",
+                    {"model": "ma", "tenant": "ta"}).inc(3)
+        obs.counter("serving.fam_done",
+                    {"model": "mb", "tenant": "tb"}).inc(4)
+        obs.counter("serving.fam_done").inc(5)
+        obs.histogram("serving.fam_lat",
+                      {"model": "ma"}).observe(0.5)
+        text = obs.render_prometheus(prefix="pt_")
+        # ONE TYPE line per family, all labeled samples under it
+        assert text.count("# TYPE pt_serving_fam_done_total counter") == 1
+        assert 'pt_serving_fam_done_total{model="ma",tenant="ta"} 3' in text
+        assert 'pt_serving_fam_done_total{model="mb",tenant="tb"} 4' in text
+        assert "\npt_serving_fam_done_total 5" in text
+        assert ('pt_serving_fam_lat_seconds_bucket{model="ma",le="+Inf"} 1'
+                in text)
+        assert 'pt_serving_fam_lat_seconds_count{model="ma"} 1' in text
+        # the strict parser reads its own output back
+        parsed = obs.parse_prometheus(text)
+        assert parsed['pt_serving_fam_done_total{model="ma",tenant="ta"}'] \
+            == 3.0
+
+    def test_request_labels_tick_labeled_histogram(self, model_dir):
+        r = _router()
+        try:
+            r.deploy("lbl", model_dir, replicas=1)
+            h = obs.histogram("serving.request_latency_interactive",
+                              {"model": "lbl", "tenant": "tz"})
+            n0 = h.count
+            r.predict("lbl", {"x": _x()}, tenant="tz",
+                      priority="interactive", timeout=30)
+            assert h.count == n0 + 1
+        finally:
+            r.stop()
+
+
+# -- global placement --------------------------------------------------------
+
+class TestGlobalPlacement:
+    def test_autoscale_tick_respects_budget(self, model_dir, model_dir_b):
+        r = _router(replica_budget=3)
+        try:
+            r.deploy("a", model_dir, replicas=2)
+            r.deploy("b", model_dir_b, replicas=1)
+            granted = r.autoscale_tick()
+            assert set(granted) == {"a:v1", "b:v1"}
+            assert sum(granted.values()) <= 3
+            assert all(v >= 1 for v in granted.values())
+        finally:
+            r.stop()
+
+    def test_router_health_shape(self, model_dir):
+        r = _router(replica_budget=4)
+        try:
+            r.deploy("a", model_dir, replicas=1)
+            r.set_quota("t1", rows_per_s=5, max_inflight=2)
+            h = r.health()
+            assert h["replica_budget"] == 4
+            assert h["tenants"]["t1"]["max_inflight"] == 2
+            v = h["deployments"]["a"]["versions"]["v1"]
+            assert v["tier"] == "warm" and v["pool"]["ready"]
+        finally:
+            r.stop()
