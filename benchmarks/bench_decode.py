@@ -50,12 +50,22 @@ Two further legs ride the same harness (ISSUE 15):
                      reduction; smoke asserts >= 50% fewer prompt
                      tokens prefilled and bitwise-identical outputs
                      warm vs cold.
+  --multi-turn     : the conversational leg (ISSUE 20): K users x M
+                     turns, each turn's prompt the user's FULL history
+                     plus one utterance, served by a 3-replica
+                     session-enabled ReplicaPool (session pins +
+                     sticky affinity) vs a session-less pool fed the
+                     identical full-history prompts.  Reported:
+                     pool-wide prefill-token reduction, sticky-affinity
+                     hits, pinned pages; smoke asserts >= 50% fewer
+                     prefill tokens and bitwise warm == cold per turn.
 
 Usage:
   python benchmarks/bench_decode.py            # full run, prints JSON
   python benchmarks/bench_decode.py --smoke    # quick run + assertions
   python benchmarks/bench_decode.py --long-prompts [--smoke]
   python benchmarks/bench_decode.py --repeated-prefix [--smoke]
+  python benchmarks/bench_decode.py --multi-turn [--smoke]
 """
 from __future__ import annotations
 
@@ -305,6 +315,132 @@ def repeated_prefix_report(args):
     return 0
 
 
+def _ensure_host_devices(n):
+    """Force >= ``n`` virtual CPU devices for the pool legs — env-only,
+    so it must run BEFORE jax's backend initializes."""
+    if "jax" in sys.modules:
+        return
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    os.environ["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=%d" % n]).strip()
+
+
+def multi_turn_report(args):
+    """Conversational sessions vs session-less re-prefill: K users hold
+    M-turn conversations against a 3-replica pool.  Turn t's prompt is
+    the user's whole history (turn t-1's prompt + its generated tokens)
+    plus a fresh utterance — the bitwise contract makes warm and cold
+    prompts IDENTICAL, so the only difference the session machinery may
+    make is how much of each prompt is recomputed."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.executor import compile_count
+
+    n_users = args.requests or (4 if args.smoke else 8)
+    n_turns = 4 if args.smoke else 6
+    max_new = args.max_new or 8
+    rng = np.random.RandomState(9)
+    base = [rng.randint(1, VOCAB, size=24).astype(np.int32)
+            for _ in range(n_users)]
+    utts = [[rng.randint(1, VOCAB, size=16).astype(np.int32)
+             for _ in range(n_turns - 1)] for _ in range(n_users)]
+
+    model = build_model()
+    prefill_tokens = obs.counter("serving.decode.prefill_tokens")
+    sticky = obs.counter("serving.affinity.sticky")
+
+    def _cfg(**kw):
+        return serving.DecodeConfig(
+            num_slots=2, page_size=8,
+            max_seq_len=32 * (n_turns + 1), max_new_tokens=max_new,
+            prefill_chunk_tokens=32, queue_capacity=256, **kw)
+
+    legs = {}
+    # warm leg drives the conversations (its outputs BUILD the
+    # histories); the cold leg replays the identical full-history
+    # prompts through a session-less pool
+    pool = serving.ReplicaPool(None, replicas=3, decode_model=model,
+                               decode_config=_cfg(prefix_cache=True),
+                               supervisor_interval_s=0.05)
+    c0 = compile_count()
+    p0, s0 = prefill_tokens.value, sticky.value
+    hists = [list(map(int, b)) for b in base]
+    warm = [[] for _ in range(n_users)]
+    t0 = time.perf_counter()
+    for t in range(n_turns):
+        if t > 0:
+            for u in range(n_users):
+                hists[u] = hists[u] + list(map(int, utts[u][t - 1]))
+        futs = [pool.generate_async(np.asarray(hists[u], np.int32),
+                                    max_new_tokens=max_new,
+                                    session="user-%d" % u)
+                for u in range(n_users)]
+        for u, f in enumerate(futs):
+            out = list(map(int, f.result(timeout=600)))
+            warm[u].append(out)
+            hists[u] = hists[u] + out
+    legs["warm"] = {
+        "elapsed_s": round(time.perf_counter() - t0, 4),
+        "prefill_tokens": prefill_tokens.value - p0,
+        "sticky_affinity_hits": sticky.value - s0,
+        "pinned_pages": pool.sessions.stats()["pinned_pages"],
+        "compiles_during_serve": compile_count() - c0,
+    }
+    pool.stop()
+
+    cold_pool = serving.ReplicaPool(None, replicas=3, decode_model=model,
+                                    decode_config=_cfg(),
+                                    supervisor_interval_s=0.05)
+    c0 = compile_count()
+    p0 = prefill_tokens.value
+    hists = [list(map(int, b)) for b in base]
+    cold = [[] for _ in range(n_users)]
+    t0 = time.perf_counter()
+    for t in range(n_turns):
+        if t > 0:
+            for u in range(n_users):
+                hists[u] = hists[u] + list(map(int, utts[u][t - 1]))
+        futs = [cold_pool.generate_async(np.asarray(hists[u], np.int32),
+                                         max_new_tokens=max_new)
+                for u in range(n_users)]
+        for u, f in enumerate(futs):
+            out = list(map(int, f.result(timeout=600)))
+            cold[u].append(out)
+            hists[u] = hists[u] + out
+    legs["cold"] = {
+        "elapsed_s": round(time.perf_counter() - t0, 4),
+        "prefill_tokens": prefill_tokens.value - p0,
+        "compiles_during_serve": compile_count() - c0,
+    }
+    cold_pool.stop()
+
+    bitwise = warm == cold
+    reduction = 1.0 - (legs["warm"]["prefill_tokens"]
+                       / legs["cold"]["prefill_tokens"])
+    report = {"decode_multi_turn": {
+        "workload": {
+            "users": n_users, "turns": n_turns,
+            "base_prompt_tokens": 24, "utterance_tokens": 16,
+            "max_new_tokens": max_new, "replicas": 3,
+        },
+        "warm": legs["warm"],
+        "cold": legs["cold"],
+        "prefill_token_reduction": round(reduction, 3),
+        "bitwise_equal": bool(bitwise),
+    }}
+    print(json.dumps(report, indent=2))
+    if args.smoke:
+        assert bitwise, "sessions changed some turn's tokens"
+        assert legs["warm"]["compiles_during_serve"] == 0, (
+            "warm leg served with a recompile: %r" % legs["warm"])
+        assert reduction >= 0.5, (
+            "sessions avoided only %.0f%% of prefill tokens"
+            % (reduction * 100))
+        assert legs["warm"]["sticky_affinity_hits"] > 0, legs["warm"]
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
@@ -315,6 +451,10 @@ def main(argv=None):
     parser.add_argument("--repeated-prefix", action="store_true",
                         help="shared-prefix leg: prefix cache hit rate "
                              "+ prefill-token reduction")
+    parser.add_argument("--multi-turn", action="store_true",
+                        help="conversational leg: session pins + sticky "
+                             "affinity vs session-less full-history "
+                             "re-prefill over a 3-replica pool")
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--max-new", type=int, default=None)
     parser.add_argument("--interarrival-ms", type=float, default=None)
@@ -325,6 +465,12 @@ def main(argv=None):
                         help="prefill chunk budget for --long-prompts")
     args = parser.parse_args(argv)
 
+    if args.multi_turn:
+        if "JAX_PLATFORMS" not in os.environ \
+                and "JAX_PLATFORM_NAME" not in os.environ:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        _ensure_host_devices(4)
+        return multi_turn_report(args)
     if args.long_prompts:
         return long_prompts_report(args)
     if args.repeated_prefix:

@@ -141,11 +141,15 @@ def build_decode_model():
     return T.build_decode_model(params, meta)
 
 
-def make_engine(model_dir, replicas=1, max_replicas=None, decode=False):
+def make_engine(model_dir, replicas=1, max_replicas=None, decode=False,
+                session_mix=False):
     """One serving frontend: a single engine (``replicas=1``) or an
     N-replica pool — same admission surface, so every leg below is
     agnostic to which it got.  ``decode=True`` attaches a decode model
-    so the mixed legs can route ``generate_async`` through the pool."""
+    so the mixed legs can route ``generate_async`` through the pool;
+    ``session_mix=True`` additionally turns on the prefix cache so the
+    pool auto-creates a SessionStore (the --session-mix legs tag their
+    decode arrivals with conversation ids)."""
     from paddle_tpu import serving
 
     decode_kw = {}
@@ -154,7 +158,9 @@ def make_engine(model_dir, replicas=1, max_replicas=None, decode=False):
             decode_model=build_decode_model(),
             decode_config=serving.DecodeConfig(
                 num_slots=4, page_size=8, max_seq_len=64,
-                max_new_tokens=DECODE_NEW_TOKENS))
+                max_new_tokens=DECODE_NEW_TOKENS,
+                prefill_chunk_tokens=16 if session_mix else None,
+                prefix_cache=bool(session_mix)))
     if replicas == 1 and max_replicas is None and not decode:
         return serving.InferenceEngine(
             model_dir, batch_buckets=(2, 4, 8, 16), max_batch_size=16,
@@ -230,7 +236,8 @@ def build_schedule(process, rate, n, seed, capacity):
     return sched
 
 
-def run_open_loop(engine, schedule, seed, decode_every=0):
+def run_open_loop(engine, schedule, seed, decode_every=0,
+                  session_mix=0):
     """Submit the schedule open-loop; resolve everything; per-class
     outcome table.  Returns (per_class dict, overall dict).
 
@@ -240,6 +247,13 @@ def run_open_loop(engine, schedule, seed, decode_every=0):
     predict+generate traffic shape a real LM frontend serves.  Generate
     outcomes are tallied separately under ``overall["generate"]``; the
     per-class predict table keeps its meaning.
+
+    ``session_mix=K``: the decode arrivals cycle over K live
+    conversations — arrival j carries ``session="conv-<j mod K>"`` and
+    that conversation's FIXED prompt, so repeated turns of the same
+    conversation hit its session-pinned KV pages and sticky affinity
+    routes them to the owning replica (the conversational traffic
+    shape; serving/sessions.py).
 
     Latency quantiles come from the LIVE telemetry histograms
     (``serving.request_latency_<class>``, snapshotted before/after the
@@ -271,10 +285,15 @@ def run_open_loop(engine, schedule, seed, decode_every=0):
         arrival = time.perf_counter()
         if decode_every and i % decode_every == 3:
             gen["attempted"] += 1
+            session_kw = {}
+            if session_mix:
+                sid = (i // decode_every) % session_mix
+                session_kw = dict(session="conv-%d" % sid)
             try:
                 gf = engine.generate_async(
-                    prompts[i % 64], max_new_tokens=DECODE_NEW_TOKENS,
-                    priority=cls)
+                    prompts[sid % 64] if session_mix else prompts[i % 64],
+                    max_new_tokens=DECODE_NEW_TOKENS,
+                    priority=cls, **session_kw)
             except serving.ServingError:
                 gen["shed"] += 1
             else:
@@ -300,6 +319,34 @@ def run_open_loop(engine, schedule, seed, decode_every=0):
             gen["failed"] += 1   # typed terminal outcome (shed at pop,
         else:                    # degraded, cancelled...) — not a hang
             gen["ok"] += 1 if len(toks) else 0
+    if session_mix and gen_futs:
+        # one CLOSING turn per conversation, after the open-loop storm
+        # fully resolved: under overload the storm's turns of one
+        # conversation overlap in the queue (turn k+1 admitted before
+        # turn k retired and parked), so stickiness there is luck — but
+        # by now every conversation is parked, so these turns MUST ride
+        # session-sticky affinity onto the replica holding their pins
+        close = {"attempted": 0, "ok": 0, "shed": 0, "failed": 0}
+        closing = []
+        for sid in range(session_mix):
+            close["attempted"] += 1
+            try:
+                closing.append(engine.generate_async(
+                    prompts[sid % 64], max_new_tokens=DECODE_NEW_TOKENS,
+                    session="conv-%d" % sid))
+            except serving.ServingError:
+                close["shed"] += 1
+        for gf in closing:
+            try:
+                toks = gf.result(timeout=120)
+            except serving.ServingError:
+                close["failed"] += 1
+            else:
+                close["ok"] += 1 if len(toks) else 0
+        # tallied apart from gen: closing turns are an epilogue, not
+        # part of the leg's scheduled arrivals (the smoke identity
+        # resolved == requests must keep holding)
+        gen["closing_turns"] = close
     gen["unresolved"] = gen["attempted"] - gen["shed"] - gen["failed"] \
         - gen["ok"]
     unresolved = 0
@@ -365,7 +412,7 @@ def run_open_loop(engine, schedule, seed, decode_every=0):
 
 
 def run_leg(engine, process, rate, n, seed, capacity, flaky_every=0,
-            decode_every=0):
+            decode_every=0, session_mix=0):
     from paddle_tpu import observability as obs
     from paddle_tpu.testing import faults
 
@@ -383,23 +430,28 @@ def run_leg(engine, process, rate, n, seed, capacity, flaky_every=0,
 
         with faults.flaky_execute(times=None, match=every_nth):
             per_class, overall = run_open_loop(engine, schedule, seed,
-                                               decode_every=decode_every)
+                                               decode_every=decode_every,
+                                               session_mix=session_mix)
     else:
         per_class, overall = run_open_loop(engine, schedule, seed,
-                                           decode_every=decode_every)
+                                           decode_every=decode_every,
+                                           session_mix=session_mix)
     overall["retries"] = obs.counter("serving.retries").value - r0
     overall["process"] = process
     return {"per_class": per_class, "overall": overall}
 
 
 def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1,
-                   decode=False):
+                   decode=False, session_mix=0):
+    from paddle_tpu import observability as obs
     from paddle_tpu.testing import faults
 
     td = tempfile.mkdtemp()
     model_dir = save_model(os.path.join(td, "model"))
     legs = {}
-    engine = make_engine(model_dir, replicas=replicas, decode=decode)
+    engine = make_engine(model_dir, replicas=replicas, decode=decode,
+                         session_mix=session_mix)
+    sticky0 = obs.counter("serving.affinity.sticky").value
     old_switch = sys.getswitchinterval()
     sys.setswitchinterval(0.001)
     try:
@@ -418,7 +470,8 @@ def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1,
                         legs["%s_decode" % proc] = run_leg(
                             engine, proc, rate, n_requests,
                             seed + attempt + 13, capacity,
-                            decode_every=DECODE_EVERY)
+                            decode_every=DECODE_EVERY,
+                            session_mix=session_mix)
                 legs["%s_faulty" % processes[0]] = run_leg(
                     engine, processes[0], rate, n_requests,
                     seed + attempt + 7, capacity, flaky_every=7)
@@ -440,8 +493,23 @@ def run_load_bench(smoke, process, overload, n_requests, seed, replicas=1,
         "seed": seed,
         "legs": legs,
     }
+    if session_mix:
+        out["session_mix"] = {
+            "conversations": session_mix,
+            "sticky_affinity_hits":
+                obs.counter("serving.affinity.sticky").value - sticky0,
+        }
     if smoke:
         _assert_smoke(out)
+        if session_mix:
+            # structural: conversations actually went sticky, and every
+            # tagged generation reached a terminal outcome
+            assert out["session_mix"]["sticky_affinity_hits"] > 0, (
+                "no decode arrival rode its session's sticky affinity: "
+                "%r" % (out["session_mix"],))
+            for name, leg in legs.items():
+                gen = leg["overall"].get("generate")
+                assert gen is None or gen["unresolved"] == 0, (name, gen)
     return out
 
 
@@ -819,6 +887,13 @@ def main(argv=None):
                         help="add a mixed predict+generate leg per "
                              "arrival process: every %dth arrival rides "
                              "the pool's decode schedulers" % DECODE_EVERY)
+    parser.add_argument("--session-mix", type=int, nargs="?", const=8,
+                        default=0, metavar="K",
+                        help="conversational decode arrivals: cycle the "
+                             "generate traffic over K live sessions "
+                             "(default 8) with fixed per-session "
+                             "prompts — session pins + sticky affinity "
+                             "on the pool (implies --decode)")
     parser.add_argument("--scaling", action="store_true",
                         help="replica-scaling ladder: one warm pool, "
                              "rotation resized %s, fixed offered rate"
@@ -850,7 +925,9 @@ def main(argv=None):
         results["load"] = run_load_bench(args.smoke, args.process,
                                          args.overload or 3.0, n, args.seed,
                                          replicas=args.replicas,
-                                         decode=args.decode)
+                                         decode=args.decode
+                                         or bool(args.session_mix),
+                                         session_mix=args.session_mix)
     print(json.dumps(results, indent=2, sort_keys=True))
     return results
 

@@ -92,6 +92,21 @@ each failure deterministically; ``benchmarks/bench_load.py`` +
 ``tools/check_slo.py`` gate goodput-under-deadline per class against
 open-loop overload, and ``tools/check_decode_resilience.py`` gates the
 kill-mid-decode bitwise-replay contract.
+
+Multi-turn chat gets CONVERSATIONAL SESSIONS (sessions.py;
+docs/serving.md "Sessions, affinity & disaggregated prefill"):
+``generate(..., session="user-42")`` parks the finished turn's KV
+pages refcount-PINNED in the owning replica's cache
+(:class:`SessionStore`, TTL + capacity LRU; ``end_session()`` or
+expiry releases the pins), prefix-affinity admission routes the next
+turn back to the replica holding them (session-sticky →
+longest-prefix-match → least-loaded, with health always overriding
+affinity), and ``ReplicaPool(roles=("prefill", "decode", ...))``
+disaggregates the phases — prefill-role replicas hand finished
+prompts to decode-role siblings as host-staged ``HandoffPacket``
+transfers.  Warm turns are bitwise-identical to cold full-history
+re-prefill (``tools/check_sessions.py`` gates it); a dead owner's
+conversation resumes on a sibling from its journal.
 """
 from __future__ import annotations
 
@@ -102,6 +117,7 @@ from .decode_scheduler import (
     DecodeModel,
     DecodeScheduler,
     GenerateRequest,
+    HandoffPacket,
 )
 from .engine import BatchExecutor, InferenceEngine
 from .errors import (
@@ -121,6 +137,7 @@ from .replica_pool import ReplicaPool
 from .request_queue import PRIORITY_CLASSES, Request, RequestQueue
 from .resilient import CircuitBreaker, ResilientDispatcher, WorkerSupervisor
 from .router import ModelRouter, RoutedRequest, TenantQuota
+from .sessions import SessionRecord, SessionStore, scoped_session
 
 __all__ = [
     "InferenceEngine",
@@ -144,6 +161,10 @@ __all__ = [
     "DecodeConfig",
     "DecodeJournal",
     "GenerateRequest",
+    "HandoffPacket",
+    "SessionStore",
+    "SessionRecord",
+    "scoped_session",
     "PagedKVCache",
     "write_prompt_kv",
     "write_token_kv",

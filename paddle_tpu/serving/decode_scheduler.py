@@ -75,6 +75,7 @@ the next iteration boundary instead of decoding to max_len for nobody.
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -96,7 +97,7 @@ from .request_queue import Request, RequestQueue
 from .worker import RestartableWorker
 
 __all__ = ["DecodeModel", "DecodeConfig", "DecodeJournal",
-           "GenerateRequest", "DecodeScheduler"]
+           "GenerateRequest", "DecodeScheduler", "HandoffPacket"]
 
 _requests = _obs.counter("serving.decode.requests")
 _tokens = _obs.counter("serving.decode.tokens")
@@ -125,6 +126,17 @@ _step_retries = _obs.counter("serving.decode.step_retries")
 _cancelled = _obs.counter("serving.decode.cancelled")
 _replays = _obs.counter("serving.decode.replays")
 _kv_guard_trips = _obs.counter("serving.decode.kv_guard_trips")
+# sessions / disaggregated prefill (PR 20): affinity honored counts
+# admissions whose pool-stamped preferred replica was this one; the
+# handoff family counts prefill->decode KV transfers in roles mode
+_affinity_honored = _obs.counter("serving.affinity.honored")
+_handoff_packets = _obs.counter("serving.handoff.packets")
+_handoff_pages = _obs.counter("serving.handoff.pages")
+_handoff_bytes = _obs.counter("serving.handoff.bytes")
+_handoff_injected = _obs.counter("serving.handoff.injected")
+_handoff_failed = _obs.counter("serving.handoff.failed")
+_handoff_stage_timer = _obs.timer("serving.handoff.stage")
+_session_parked_pages = _obs.counter("serving.session.pinned")
 
 
 def _sample_token(logits, key, temp, top_k):
@@ -371,10 +383,11 @@ class GenerateRequest(Request):
     """
 
     __slots__ = ("prompt", "max_new_tokens", "token_times", "temperature",
-                 "seed", "journal", "cancelled")
+                 "seed", "journal", "cancelled", "session", "affinity",
+                 "affinity_ts", "handoff_origin")
 
     def __init__(self, prompt, max_new_tokens, deadline=None, priority=None,
-                 temperature=None, seed=None):
+                 temperature=None, seed=None, session=None):
         super().__init__(feed=None, rows=1, deadline=deadline,
                          priority=priority)
         self.prompt = prompt
@@ -384,6 +397,20 @@ class GenerateRequest(Request):
         self.seed = seed
         self.journal = DecodeJournal(prompt, max_new_tokens)
         self.cancelled = False
+        # conversational session key (opaque; router-scoped): on a
+        # SUCCESSFUL retirement the owning scheduler parks the finished
+        # history's KV pages pinned and records them in the pool's
+        # SessionStore — see serving/sessions.py
+        self.session = session
+        # pool-stamped dispatch hint: preferred replica index + stamp
+        # time.  A HINT with a staleness bound, never a requirement —
+        # gates strip it when the target can't take the work
+        self.affinity = None
+        self.affinity_ts = None
+        # roles mode: the prefill replica that staged this request's KV
+        # handoff (None outside roles mode) — the session's sticky
+        # replica, since that is where the prompt's prefix pages live
+        self.handoff_origin = None
 
     @property
     def prompt_len(self):
@@ -434,6 +461,32 @@ class _Slot:
         return self.prefill_pos < self.prompt_len or not self.generated
 
 
+class HandoffPacket:
+    """Host-staged KV of one fully prefilled sequence in transit
+    between a prefill-role replica and a decode-role one (roles mode).
+
+    ``k_host``/``v_host`` are numpy ``[L, max_pages_per_seq, ps, H, D]``
+    gathers of the origin cache (rows past ``n_pages`` hold scratch
+    content and scatter back into scratch); ``first`` is the first
+    sampled token (already journaled on the origin); ``hashes`` the
+    prompt chain hashes so the destination can re-register the prefix.
+    """
+
+    __slots__ = ("req", "k_host", "v_host", "n_pages", "kv_len",
+                 "hashes", "origin", "first")
+
+    def __init__(self, req, k_host, v_host, n_pages, kv_len, hashes,
+                 origin, first):
+        self.req = req
+        self.k_host = k_host
+        self.v_host = v_host
+        self.n_pages = int(n_pages)
+        self.kv_len = int(kv_len)
+        self.hashes = hashes
+        self.origin = int(origin)
+        self.first = int(first)
+
+
 class DecodeScheduler:
     """Continuous-batching generation over a :class:`DecodeModel`.
 
@@ -456,7 +509,9 @@ class DecodeScheduler:
     """
 
     def __init__(self, model, config=None, autostart=True, queue=None,
-                 gate=None, name=None, evict_on_death=False, breaker=None):
+                 gate=None, name=None, evict_on_death=False, breaker=None,
+                 sessions=None, replica_index=0, role="both",
+                 on_handoff=None, claim=None):
         import jax
 
         self.model = model
@@ -468,6 +523,34 @@ class DecodeScheduler:
                 "prefill_chunk_tokens / prefix_cache require a model with "
                 "prefill_chunk_fn (see models.transformer."
                 "build_decode_model); %r has none" % (model.name,))
+        if role not in ("both", "prefill", "decode"):
+            raise ServingError(
+                "role must be 'both', 'prefill', or 'decode', got %r"
+                % (role,))
+        if role == "prefill" and not self._use_chunks:
+            raise ServingError(
+                "role='prefill' requires the chunked prefill path "
+                "(a model with prefill_chunk_fn)")
+        if sessions is not None and not cfg.prefix_cache:
+            raise ServingError(
+                "sessions require prefix_cache=True: a session pin is an "
+                "extra refcount on the prompt's prefix-index chain")
+        # conversational sessions (serving/sessions.py): the store is
+        # SHARED across a pool's replicas; each scheduler only parks
+        # into and releases pins against its OWN cache
+        self._sessions = sessions
+        self._replica_index = int(replica_index)
+        self._role = role
+        self._on_handoff = on_handoff
+        # cross-thread pin-release + handoff-injection queues: the cache
+        # allocator is worker-owned, so other threads (session TTL
+        # sweeps, a sibling's handoff dispatch) only ever ENQUEUE here;
+        # the worker drains at each loop iteration — or the enqueuer
+        # applies directly under the life lock once the worker is
+        # provably dead (stop/give-up cleanup must still land)
+        self._pending_lock = threading.Lock()
+        self._pending_release = []
+        self._pending_handoffs = collections.deque()
         self._cache = PagedKVCache(
             model.num_layers,
             cfg.num_pages or (
@@ -498,6 +581,11 @@ class DecodeScheduler:
             shed_counter=_obs.counter("serving.decode.shed_admission"),
             gauge_prefix="serving.decode.queue_depth")
         self._gate = gate
+        # claim predicate: evaluated by the shared queue UNDER ITS LOCK
+        # against the head actually popped — closes the peek-then-pop
+        # window where two replicas approve different heads and pop
+        # crosswise, stealing each other's affinity-tagged requests
+        self._claim = claim
         self._breaker = breaker
         self._evict_on_death = bool(evict_on_death)
         # reset_pools safety: the cache refuses to zero pages under
@@ -527,7 +615,7 @@ class DecodeScheduler:
             classify=_resilience.is_transient_error)
         self._jit = JitStepCache(
             lambda key: self._build_step(key, donate),
-            cap=2 * len(self.prefill_buckets) + 10, name="decode-steps")
+            cap=2 * len(self.prefill_buckets) + 12, name="decode-steps")
         self._slots = [None] * cfg.num_slots
         self._tables = np.zeros(
             (cfg.num_slots, self._cache.max_pages_per_seq), np.int32)
@@ -569,6 +657,27 @@ class DecodeScheduler:
             from ..parallel.flash_attention import paged_kv_finite
 
             return jax.jit(paged_kv_finite)
+        if key[0] == "hgather":
+            # roles mode, prefill side: pull one sequence's pages to the
+            # host for handoff.  Fixed shape [L, max_pages_per_seq, ...]
+            # whatever the prompt length — pad index entries point at
+            # scratch page 0, whose gathered rows are simply ignored
+            def hgather(k_pool, v_pool, idx):
+                return k_pool[:, idx], v_pool[:, idx]
+
+            return jax.jit(hgather)
+        if key[0] == "hscatter":
+            # roles mode, decode side: land a handoff packet's staged
+            # pages into this cache.  Pad target entries aim at scratch
+            # page 0 (duplicate scatter indices all write scratch —
+            # whichever lands, scratch content is don't-care).  Pools
+            # donated on TPU like every other in-place pool update.
+            def hscatter(k_pool, v_pool, k_new, v_new, idx):
+                return (k_pool.at[:, idx].set(k_new),
+                        v_pool.at[:, idx].set(v_new))
+
+            return jax.jit(hscatter,
+                           donate_argnums=(0, 1) if donate else ())
         if key[0] == "decode":
             def decode(tokens, positions, k_pool, v_pool, tables, kv_lens,
                        seeds, temps):
@@ -684,6 +793,25 @@ class DecodeScheduler:
                     np.asarray(self._jit.get(("kvguard", n))(
                         self._cache.k_pool, self._cache.v_pool,
                         jnp.zeros((n,), jnp.int32)))
+            # roles mode: compile the handoff leg this replica
+            # dispatches (all-scratch indices — real pages see the same
+            # program), so the first conversation never pays a compile
+            mp = self._cache.max_pages_per_seq
+            if self._role == "prefill" and self._on_handoff is not None:
+                k, v = self._jit.get(("hgather",))(
+                    self._cache.k_pool, self._cache.v_pool,
+                    jnp.zeros((mp,), jnp.int32))
+                np.asarray(k), np.asarray(v)
+            if self._role == "decode":
+                zero = jnp.zeros(
+                    (self._cache.num_layers, mp, cfg.page_size,
+                     self._cache.num_heads, self._cache.head_dim),
+                    self._cache.dtype)
+                kp, vp = self._jit.get(("hscatter",))(
+                    self._cache.k_pool, self._cache.v_pool, zero, zero,
+                    jnp.zeros((mp,), jnp.int32))
+                np.asarray(kp[0, 0, 0, 0, 0])
+                self._cache.k_pool, self._cache.v_pool = kp, vp
         return self
 
     # -- lifecycle -----------------------------------------------------------
@@ -771,7 +899,7 @@ class DecodeScheduler:
 
     # -- client API ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
-               priority=None, temperature=None, seed=None):
+               priority=None, temperature=None, seed=None, session=None):
         """Admit one prompt; returns its :class:`GenerateRequest` future.
         Raises ``ServingClosed`` when stopped, ``ServingQueueFull`` under
         backpressure, ``ServingError`` for malformed prompts.
@@ -808,16 +936,16 @@ class DecodeScheduler:
         req = self._queue.put(
             GenerateRequest(tokens, n_new, deadline=deadline,
                             priority=priority, temperature=temperature,
-                            seed=seed))
+                            seed=seed, session=session))
         _requests.inc()
         return req
 
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
-                 timeout=None, temperature=None, seed=None):
+                 timeout=None, temperature=None, seed=None, session=None):
         """Synchronous generate: the generated int32 token ids."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            deadline_ms=deadline_ms, temperature=temperature,
-                           seed=seed).result(timeout=timeout)
+                           seed=seed, session=session).result(timeout=timeout)
 
     def stats(self):
         active = sum(1 for s in self._slots if s is not None)
@@ -836,10 +964,17 @@ class DecodeScheduler:
             "prefill_buckets": list(self.prefill_buckets),
             "prefill_chunk_tokens": self.config.prefill_chunk_tokens,
             "prefix_cache": self.config.prefix_cache,
+            "role": self._role,
         }
         if self.config.prefix_cache:
             st["prefix"] = self._cache.prefix_stats()
         return st
+
+    def cache_stats(self):
+        """The cache allocator snapshot incl. the leaked-refcount sweep
+        (``PagedKVCache.stats()``) — the gate's no-leak assertion reads
+        this after session expiry."""
+        return self._cache.stats()
 
     # -- worker --------------------------------------------------------------
     def _sampling_params(self, req):
@@ -894,7 +1029,58 @@ class DecodeScheduler:
         with self._hol_lock:
             self._hol = (req, cached_pages, hashes)
 
+    # -- sessions & handoff (cross-thread entry points) ----------------------
+    def release_session_pins(self, pages):
+        """Release session-pinned pages back to this scheduler's cache.
+        Safe from ANY thread (it is the SessionStore's release callback,
+        fired by TTL sweeps, capacity evictions, and end_session on
+        arbitrary callers): the pages are queued and freed ON the worker
+        at its next loop iteration.  When the worker is provably dead
+        (stop/give-up/cold-demotion cleanup), the queue is drained
+        directly under the life lock instead — a dead worker never
+        races, and the lock blocks a concurrent restart spawn."""
+        with self._pending_lock:
+            self._pending_release.extend(int(p) for p in pages)
+        self.drain_pending_releases()
+
+    def drain_pending_releases(self):
+        """Apply queued pin releases if the worker is provably dead;
+        no-op otherwise (the live worker drains its own queue).  The
+        pool calls this after stopping a replica so ``SessionStore.
+        clear()``'s releases land even with every worker gone."""
+        with self._worker.life_lock:
+            if self._worker.alive:
+                return False
+            self._drain_pending()
+        return True
+
+    def _drain_pending(self):
+        """Free queued session-pin releases (worker thread, or any
+        thread holding the dead-worker proof)."""
+        with self._pending_lock:
+            pages, self._pending_release = self._pending_release, []
+        if pages:
+            self._cache.free(pages)
+
+    def inject_handoff(self, packet):
+        """Queue a prefilled sequence's staged KV for seating on this
+        (decode-role) replica — called by the pool's handoff dispatch
+        from the ORIGIN replica's worker thread.  Returns False when
+        this scheduler is stopping (the caller re-routes or fails the
+        request)."""
+        if self._worker.stopping:
+            return False
+        with self._pending_lock:
+            self._pending_handoffs.append(packet)
+        return True
+
     def _fail_all(self, exc):
+        self._drain_pending()
+        with self._pending_lock:
+            packets = list(self._pending_handoffs)
+            self._pending_handoffs.clear()
+        for pk in packets:
+            pk.req.fail(exc)
         hol = self._take_hol()
         if hol is not None:
             req, cached_pages, _ = hol
@@ -921,6 +1107,9 @@ class DecodeScheduler:
         self._note_ts = time.perf_counter()
         self._note_retired = self._retired_total
         while True:
+            # queued session-pin releases first: freed pages may be
+            # exactly what this iteration's admission needs
+            self._drain_pending()
             self._admit()
             if self._active_count():
                 if self._worker.stopping and not self._drain:
@@ -937,7 +1126,8 @@ class DecodeScheduler:
             self._note_retired = self._retired_total
             if self._worker.stopping and (not self._drain
                                           or (self._queue.depth() == 0
-                                              and self._hol is None)):
+                                              and self._hol is None
+                                              and not self._pending_handoffs)):
                 if not self._drain:
                     self._fail_all(ServingClosed("decode scheduler stopped"))
                 return
@@ -957,11 +1147,105 @@ class DecodeScheduler:
         self._note_ts = now
         self._note_retired = self._retired_total
 
+    def _admit_handoffs(self):
+        """Seat injected handoff packets (sequences a prefill-role
+        sibling already prefilled) ahead of fresh queue work — their
+        KV is staged on the host and their callers are further along.
+        Returns False when a packet is blocked on pages (fresh
+        admission must also wait: the packet is effectively this
+        replica's head of line)."""
+        cache = self._cache
+        while self._active_count() < self.config.max_active:
+            with self._pending_lock:
+                packet = (self._pending_handoffs[0]
+                          if self._pending_handoffs else None)
+            if packet is None:
+                return True
+            req = packet.req
+            if req.cancelled or req.expired():
+                with self._pending_lock:
+                    self._pending_handoffs.popleft()
+                if req.cancelled:
+                    _cancelled.inc()
+                    req.fail(ServingCancelled(
+                        "request cancelled during prefill->decode "
+                        "handoff"))
+                else:
+                    _expired.inc()
+                    _expired_mid_decode.inc()
+                    req.fail(ServingTimeout(
+                        "deadline expired during prefill->decode "
+                        "handoff"))
+                self._completed += 1
+                continue
+            need = cache.pages_for(req.prompt_len + req.max_new_tokens)
+            if need > cache.num_pages - 1:
+                with self._pending_lock:
+                    self._pending_handoffs.popleft()
+                req.fail(ServingError(
+                    "handed-off sequence needs %d pages but the pool "
+                    "has %d" % (need, cache.num_pages - 1)))
+                self._completed += 1
+                continue
+            pages = cache.alloc(need)
+            if pages is None:
+                # wait for a retirement; don't admit fresh work past a
+                # staged packet (it holds host copies, not pool pages,
+                # so waiting leaks nothing)
+                return False
+            with self._pending_lock:
+                self._pending_handoffs.popleft()
+            self._seat_handoff(packet, pages)
+        return not self._pending_handoffs
+
+    def _seat_handoff(self, packet, pages):
+        """Land one handoff packet: scatter the staged KV into our
+        freshly reserved pages and seat the slot already DECODING (the
+        origin sampled the first token; it is journaled there)."""
+        import jax.numpy as jnp
+
+        req = packet.req
+        idx = next(i for i, s in enumerate(self._slots) if s is None)
+        idxvec = np.zeros((self._cache.max_pages_per_seq,), np.int32)
+        idxvec[:packet.n_pages] = pages[:packet.n_pages]
+        fn = self._jit.get(("hscatter",))
+        with _handoff_stage_timer.time():
+            kp, vp = fn(self._cache.k_pool, self._cache.v_pool,
+                        jnp.asarray(packet.k_host),
+                        jnp.asarray(packet.v_host),
+                        jnp.asarray(idxvec))
+            self._cache.k_pool, self._cache.v_pool = kp, vp
+        slot = _Slot(req, pages, hashes=packet.hashes)
+        slot.kv_len = packet.kv_len
+        slot.generated.append(packet.first)
+        self._slots[idx] = slot
+        self._tables[idx] = self._cache.table_row(pages)
+        if self.config.prefix_cache and packet.hashes:
+            # re-register the prompt's full pages HERE: the next turn's
+            # prefix probe (and its session pin) must find them in the
+            # replica that will actually serve the decode
+            for pi in range(min(packet.kv_len // self.config.page_size,
+                                len(packet.hashes), len(pages))):
+                self._cache.register_prefix(packet.hashes, pi, pages[pi])
+        _handoff_injected.inc()
+        _active_slots.set(self._active_count())
+        tel = self._telemetry
+        if tel.recording:
+            tel.emit({
+                "type": "decode_handoff", "ts": time.time(),
+                "source": "serving", "seq": req.seq, "leg": "inject",
+                "origin": packet.origin, "dest": self._replica_index,
+                "pages": packet.n_pages, "kv_len": packet.kv_len,
+            })
+        self._finish_if_done(idx)
+
     def _admit(self):
         """Fill free slots from the queue (iteration-level admission).
         Never blocks while sequences are decoding; waits briefly when
         idle so the loop doesn't spin."""
         cache, cfg = self._cache, self.config
+        if not self._admit_handoffs():
+            return                 # blocked on pages for a staged packet
         while self._active_count() < cfg.max_active:
             if self._worker.stopping and not self._drain:
                 return
@@ -978,8 +1262,13 @@ class DecodeScheduler:
                         time.sleep(0.002)  # don't spin while gated out
                     return
                 req = self._queue.get(
-                    timeout=0.0 if self._active_count() else 0.05)
+                    timeout=0.0 if self._active_count() else 0.05,
+                    accept=self._claim)
                 cached_pages, hashes = [], None
+                if (req is not None
+                        and getattr(req, "affinity", None)
+                        == self._replica_index):
+                    _affinity_honored.inc()
             if req is None:
                 return
             if req.cancelled:
@@ -1206,7 +1495,65 @@ class DecodeScheduler:
             # interactive-decode SLO is written against
             _ttft_hist.observe(done - req.enqueue_ts)
             _tokens.inc()
-            self._finish_if_done(idx)
+            if not self._finish_if_done(idx):
+                self._maybe_handoff(idx)
+
+    def _maybe_handoff(self, idx):
+        """Roles mode, prefill side: a freshly prefilled (and not yet
+        finished) sequence leaves for a decode-role sibling — gather
+        its prompt pages to the host, release the local seat (the full
+        prompt pages stay REGISTERED here, rc=0-parked, so the next
+        turn's affinity probe still finds this replica warm), and hand
+        the packet to the pool.  Returns True when the slot was
+        exported (the caller must not keep using ``idx``)."""
+        if self._role != "prefill" or self._on_handoff is None:
+            return False
+        import jax.numpy as jnp
+
+        slot = self._slots[idx]
+        req = slot.req
+        n_pages = self._cache.pages_for(slot.kv_len)
+        idxvec = np.zeros((self._cache.max_pages_per_seq,), np.int32)
+        idxvec[:n_pages] = slot.pages[:n_pages]
+        fn = self._jit.get(("hgather",))
+        with _handoff_stage_timer.time():
+            k, v = fn(self._cache.k_pool, self._cache.v_pool,
+                      jnp.asarray(idxvec))
+            k_host, v_host = np.asarray(k), np.asarray(v)
+        packet = HandoffPacket(
+            req, k_host, v_host, n_pages=n_pages, kv_len=slot.kv_len,
+            hashes=slot.hashes, origin=self._replica_index,
+            first=slot.generated[-1])
+        req.handoff_origin = self._replica_index
+        self._slots[idx] = None
+        self._tables[idx] = 0
+        self._cache.free(slot.pages)
+        _active_slots.set(self._active_count())
+        _handoff_packets.inc()
+        _handoff_pages.inc(n_pages)
+        _handoff_bytes.inc(k_host.nbytes + v_host.nbytes)
+        tel = self._telemetry
+        if tel.recording:
+            tel.emit({
+                "type": "decode_handoff", "ts": time.time(),
+                "source": "serving", "seq": req.seq, "leg": "export",
+                "origin": self._replica_index, "pages": n_pages,
+                "kv_len": slot.kv_len,
+            })
+        try:
+            ok = self._on_handoff(packet)
+        except Exception as exc:  # noqa: BLE001 — worker must survive
+            ok = False
+            exc_repr = repr(exc)[:200]
+        else:
+            exc_repr = None
+        if not ok and not req.done():
+            _handoff_failed.inc()
+            req.fail(ServingDegraded(
+                "prefill->decode KV handoff failed%s"
+                % ("" if exc_repr is None else (": " + exc_repr))))
+            self._completed += 1
+        return True
 
     def _prefill(self, req, pages):
         import jax.numpy as jnp
@@ -1349,6 +1696,16 @@ class DecodeScheduler:
         sibling replica.  With donation the pools are also reset — the
         dying dispatch may have consumed them."""
         harvested = []
+        # queued pin releases apply now (the worker is provably dead);
+        # staged handoff packets are harvestable work — their KV copies
+        # die with this replica but their journals replay anywhere
+        self._drain_pending()
+        with self._pending_lock:
+            packets = list(self._pending_handoffs)
+            self._pending_handoffs.clear()
+        for pk in packets:
+            if not pk.req.done():
+                harvested.append(pk.req)
         hol = self._take_hol()
         if hol is not None:
             req, cached_pages, _ = hol
@@ -1561,6 +1918,11 @@ class DecodeScheduler:
         slot = self._slots[idx]
         self._slots[idx] = None
         self._tables[idx] = 0
+        if (error is None and self._sessions is not None
+                and getattr(slot.req, "session", None) is not None):
+            # pin BEFORE the free below: every history page stays
+            # rc >= 1 throughout, so nothing can evict it in between
+            self._park_session(slot)
         self._cache.free(slot.pages)
         self._completed += 1
         if error is None:
@@ -1599,3 +1961,37 @@ class DecodeScheduler:
                 "kv_pages_used": self._cache.used_pages,
                 "queue_depth": self._queue.depth(),
             })
+
+    def _park_session(self, slot):
+        """Park a successfully retired conversational turn (worker
+        thread, called by :meth:`_retire` BEFORE the slot's pages are
+        freed).  Registers every full history page in the prefix index
+        and takes a session pin (one extra refcount per page) so LRU
+        eviction can't reclaim the chain between turns, then records
+        the conversation in the shared :class:`SessionStore`.
+
+        Roles mode: when the turn was handed off here from a prefill
+        replica, the sticky replica stays the ORIGIN — that is where
+        the next turn's prefill (and its prefix probe) will run — so no
+        local pin is taken; the origin's warmth is its rc=0-parked
+        registered prompt pages (evictable, but the bitwise contract
+        never depends on warmth: a cold miss just re-prefills)."""
+        req = slot.req
+        history = req.journal.resume_prompt()
+        origin = getattr(req, "handoff_origin", None)
+        sticky = origin if origin is not None else self._replica_index
+        pinned = []
+        if sticky == self._replica_index:
+            ps = self.config.page_size
+            hashes = self._cache.prefix_hashes(history)
+            # publish the history's full pages: prefill registered the
+            # PROMPT'S full pages already (idempotent), decode appended
+            # the generated tokens' pages that only this path publishes
+            n_full = min(slot.kv_len // ps, len(slot.pages), len(hashes))
+            for pi in range(n_full):
+                self._cache.register_prefix(hashes, pi, slot.pages[pi])
+            pinned = self._cache.pin_prefix(history, limit=n_full)
+            _session_parked_pages.inc(len(pinned))
+        self._sessions.park(req.session, replica=sticky,
+                            history_len=len(history), pages=pinned,
+                            release=self.release_session_pins)

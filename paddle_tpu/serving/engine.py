@@ -675,7 +675,8 @@ class InferenceEngine:
 
     # -- request admission: autoregressive decode ----------------------------
     def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None,
-                       priority=None, temperature=None, seed=None):
+                       priority=None, temperature=None, seed=None,
+                       session=None):
         """Admit one generation prompt (1-D token ids); returns its
         :class:`~.decode_scheduler.GenerateRequest` future whose
         ``result(timeout)`` is the generated int32 token ids.  Requires
@@ -698,17 +699,20 @@ class InferenceEngine:
         return self._decoder.submit(prompt, max_new_tokens=max_new_tokens,
                                     deadline_ms=deadline_ms,
                                     priority=priority,
-                                    temperature=temperature, seed=seed)
+                                    temperature=temperature, seed=seed,
+                                    session=session)
 
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
-                 priority=None, timeout=None, temperature=None, seed=None):
+                 priority=None, timeout=None, temperature=None, seed=None,
+                 session=None):
         """Synchronous generate: int32 token ids (greedy by default;
         ``temperature``/``seed`` for sampling; stops at the decode
         model's ``eos_id`` or ``max_new_tokens``)."""
         return self.generate_async(
             prompt, max_new_tokens=max_new_tokens,
             deadline_ms=deadline_ms, priority=priority,
-            temperature=temperature, seed=seed).result(
+            temperature=temperature, seed=seed,
+            session=session).result(
             timeout=timeout)
 
     # -- batch execution (batcher thread) ------------------------------------

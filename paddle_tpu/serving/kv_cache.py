@@ -380,6 +380,56 @@ class PagedKVCache:
         (pool exhausted for the tail): drop the probe's references."""
         self.free(pages)
 
+    def peek_hashes(self, hashes, limit=None):
+        """How many LEADING links of ``hashes`` are indexed right now —
+        read-only (no increfs, no hit/miss accounting, no LRU touch).
+        The pool's prefix-affinity probe: called from the admission
+        thread against every replica's cache, so it must not mutate
+        worker-owned allocator state (dict reads are safe under the
+        GIL; a stale answer only skews one placement decision)."""
+        n = len(hashes) if limit is None else min(int(limit), len(hashes))
+        count = 0
+        for i in range(n):
+            if hashes[i] not in self._index:
+                break
+            count += 1
+        return count
+
+    def peek_prefix(self, tokens):
+        """:meth:`peek_hashes` over ``tokens``' own chain — leading
+        indexed full pages, capped like :meth:`lookup_prefix` (at least
+        one token always prefills)."""
+        ps = self.page_size
+        return self.peek_hashes(self._chain_hashes(tokens, ps),
+                                limit=(len(tokens) - 1) // ps)
+
+    def pin_prefix(self, tokens, limit=None):
+        """Take one EXTRA reference on each indexed page of ``tokens``'
+        leading chain — the session-pin primitive: a pinned page can't
+        be LRU-evicted until :meth:`free` drops the pin.  Unlike
+        :meth:`lookup_prefix` this is not a read-mapping probe: no
+        hit/miss accounting, no ``len - 1`` cap (the LAST full page is
+        exactly what the next turn's longer prompt wants warm).
+        Returns the pinned page ids (leading indexed run only)."""
+        hashes = self._chain_hashes(tokens, self.page_size)
+        n = len(hashes) if limit is None else min(int(limit), len(hashes))
+        pages = []
+        for i in range(n):
+            p = self._index.get(hashes[i])
+            if p is None:
+                break
+            pages.append(p)
+        for p in pages:
+            if self._rc[p] == 0:       # parked in the LRU: revive
+                del self._lru[p]
+                self._used += 1
+            elif self._rc[p] == 1:     # 1 -> 2: newly shared
+                self._shared += 1
+            self._rc[p] += 1
+        _shared_pages.set(self.shared_pages)
+        _cached_pages.set(len(self._lru))
+        return pages
+
     def register_prefix(self, hashes, page_index, page):
         """Publish one freshly WRITTEN full page: ``page`` holds the K/V
         of token block ``page_index`` under chain hash
@@ -404,6 +454,54 @@ class PagedKVCache:
             "kv_cached_pages": len(self._lru),
             "indexed_pages": len(self._index),
         }
+
+    def stats(self):
+        """Full allocator snapshot WITH the leaked-refcount sweep.
+
+        Every non-scratch page must be in exactly one state: rc >= 1
+        (used), rc = 0 and parked in the reuse LRU (indexed content), or
+        rc = 0 and on the plain free list.  ``rc_errors`` lists every
+        page that violates the partition — a page at rc > 0 that is
+        also free/parked (double accounting), or an rc = 0 page in
+        neither pool (a LEAKED reference: some early-exit path dropped
+        a page without freeing it).  The tier-1 sessions gate asserts
+        ``rc_errors == []`` and ``used_pages == 0`` after session
+        expiry, so any new release path that forgets a pin fails CI
+        instead of slowly eating the pool.  Aggregate invariants
+        (``rc_sum_matches``): #{rc>=1} == used_pages and #{rc>=2} ==
+        shared_pages, catching drift in the incremental counters."""
+        free = set(self._free)
+        errors = []
+        n_used = n_shared = 0
+        for p in range(1, self.num_pages):
+            rc = self._rc[p]
+            in_free, in_lru = p in free, p in self._lru
+            if rc < 0:
+                errors.append((p, rc, "negative refcount"))
+            elif rc > 0:
+                n_used += 1
+                if rc >= 2:
+                    n_shared += 1
+                if in_free or in_lru:
+                    errors.append((p, rc, "referenced page also in %s"
+                                   % ("free list" if in_free else "LRU")))
+            elif in_free and in_lru:
+                errors.append((p, rc, "page in free list AND LRU"))
+            elif not in_free and not in_lru:
+                errors.append((p, rc, "leaked: rc=0 but in neither "
+                               "free list nor LRU"))
+        st = {
+            "num_pages": self.num_pages,
+            "used_pages": self._used,
+            "free_pages": self.free_pages,
+            "cached_pages": len(self._lru),
+            "shared_pages": self._shared,
+            "rc_errors": errors,
+            "rc_sum_matches": (n_used == self._used
+                               and n_shared == self._shared),
+        }
+        st.update(self.prefix_stats())
+        return st
 
     # -- telemetry -----------------------------------------------------------
     def _publish(self, live_tokens):

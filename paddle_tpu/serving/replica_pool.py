@@ -125,6 +125,14 @@ _replica_swapped = _obs.counter("serving.replica.swapped")
 # admission and replay tick the same registry entries the schedulers do
 _decode_requests = _obs.counter("serving.decode.requests")
 _decode_replays = _obs.counter("serving.decode.replays")
+# prefix-affinity dispatch (sessions.py): how each admission was routed
+# (sticky to its session's replica / longest-prefix-match / no hint)
+# and how often a stamped hint had to be stripped at the gate because
+# the preferred replica could not take the work in time
+_affinity_sticky = _obs.counter("serving.affinity.sticky")
+_affinity_prefix = _obs.counter("serving.affinity.prefix")
+_affinity_none = _obs.counter("serving.affinity.none")
+_affinity_fallbacks = _obs.counter("serving.affinity.fallbacks")
 
 #: serving.replica.state_<i> gauge codes
 REPLICA_STATES = {"parked": 0, "serving": 1, "draining": 2, "ejected": 3,
@@ -171,6 +179,7 @@ class _Replica:
         self.decoder = None         # DecodeScheduler (decode_model= pools)
         self.decode_breaker = None  # its per-replica CircuitBreaker
         self.decode_failed = False  # decode worker dead past budget
+        self.role = "both"          # decode role (ReplicaPool roles=)
         self.inflight_rows = 0      # rows the worker is dispatching NOW
         self.dispatches = 0
         self.rows_served = 0
@@ -393,7 +402,8 @@ class ReplicaPool:
                  worker_max_restarts=3, supervisor_interval_s=0.1,
                  scale_down_after_s=5.0, decode_model=None,
                  decode_config=None, queue=None, tracker=None,
-                 model_label=None):
+                 model_label=None, sessions=None, roles=None,
+                 affinity_timeout_s=1.0):
         import jax
 
         buckets = sorted(set(int(b) for b in batch_buckets))
@@ -473,8 +483,48 @@ class ReplicaPool:
         self._decode_enabled = decode_model is not None
         self._decode_config = None
         self._decode_queue = None
+        self._sessions = None
+        self._affinity_timeout_s = float(affinity_timeout_s)
+        self._session_sweep_ts = time.perf_counter()
+        self._roles = None
+        if roles is not None and decode_model is None:
+            raise ServingError(
+                "roles= specializes DECODE replicas; pass decode_model=")
         if self._decode_enabled:
             dcfg = self._decode_config = decode_config or DecodeConfig()
+            if roles is not None:
+                role_list = [str(r) for r in roles]
+                if len(role_list) != n:
+                    raise ServingError(
+                        "roles needs one entry per replica (%d), got %d"
+                        % (n, len(role_list)))
+                bad = [r for r in role_list
+                       if r not in ("both", "prefill", "decode")]
+                if bad:
+                    raise ServingError(
+                        "roles must be 'both'/'prefill'/'decode', got %s"
+                        % bad)
+                if not any(r in ("both", "prefill") for r in role_list):
+                    raise ServingError(
+                        "roles leave no prefill-capable replica")
+                if not any(r in ("both", "decode") for r in role_list):
+                    raise ServingError(
+                        "roles leave no decode-capable replica")
+                self._roles = tuple(role_list)
+            # conversational sessions: sessions=False disables; a
+            # SessionStore instance is used as-is (shareable for tests);
+            # None auto-enables one whenever the prefix cache is on —
+            # a pin is an extra refcount on the prefix index's chain,
+            # so there is nothing to park without it
+            if sessions is None:
+                if dcfg.prefix_cache:
+                    from .sessions import SessionStore
+                    self._sessions = SessionStore()
+            elif sessions is not False:
+                if not dcfg.prefix_cache:
+                    raise ServingError(
+                        "sessions require DecodeConfig(prefix_cache=True)")
+                self._sessions = sessions
             # admission-order seed pinning: replay re-enqueues a request
             # (reassigning its queue seq), so a seedless sampling request
             # gets a POOL-pinned seed here — stable across replays, and
@@ -490,8 +540,10 @@ class ReplicaPool:
                 gauge_prefix="serving.decode.queue_depth")
             self._decode_queue.set_parallelism(
                 lambda: max(1, sum(1 for r in self._replicas
-                                   if self._decode_ready(r))))
+                                   if self._decode_claimable(r))))
             for rep in self._replicas:
+                rep.role = (self._roles[rep.index]
+                            if self._roles is not None else "both")
                 rep.decode_breaker = CircuitBreaker(
                     threshold=self._breaker_threshold,
                     cooldown_s=self._breaker_cooldown_s,
@@ -508,7 +560,15 @@ class ReplicaPool:
                         queue=self._decode_queue,
                         gate=(lambda r=rep: self._decode_gate(r)),
                         name="decode-replica%d" % rep.index,
-                        evict_on_death=True, breaker=rep.decode_breaker)
+                        evict_on_death=True, breaker=rep.decode_breaker,
+                        sessions=self._sessions,
+                        replica_index=rep.index, role=rep.role,
+                        on_handoff=(
+                            (lambda packet, r=rep:
+                                self._dispatch_handoff(r, packet))
+                            if rep.role == "prefill" else None),
+                        claim=(lambda req, r=rep:
+                               self._may_claim(r, req)))
                     cache = rep.decoder._cache
                     cache.k_pool = jax.device_put(cache.k_pool, rep.device)
                     cache.v_pool = jax.device_put(cache.v_pool, rep.device)
@@ -624,6 +684,14 @@ class ReplicaPool:
                     rep.decoder.stop(drain=drain, timeout=timeout)
                 self._decode_queue.drain_remaining(
                     lambda r: ServingClosed("replica pool is stopped"))
+                if self._sessions is not None:
+                    # a stopped pool holds no sessions: release every
+                    # pin (the workers are dead, so the release queues
+                    # drain directly under each life lock) — a router
+                    # cold-tier demotion must not leak pinned pages
+                    self._sessions.clear()
+                    for rep in self._replicas:
+                        rep.decoder.drain_pending_releases()
             if self._supervisor is not None:
                 self._supervisor.stop()
             if self._metrics_server is not None:
@@ -694,25 +762,127 @@ class ReplicaPool:
         return (rep.decoder is not None and not rep.decode_failed
                 and rep.decode_breaker.state != "open")
 
+    def _decode_claimable(self, rep):
+        """:meth:`_decode_ready` AND allowed to claim fresh queue work:
+        a pure decode-role replica serves handoff packets only (they
+        are injected directly, never pulled from the queue)."""
+        return rep.role != "decode" and self._decode_ready(rep)
+
     def _decode_gate(self, rep):
         """Claim gate for one replica's DecodeScheduler, consulted
         before every shared-queue pull (a parked HOL request is exempt
-        — its prefix pages are pinned locally).  Least-loaded-by-free-
-        slots: claim only when no decode-ready sibling has MORE free
-        seats; ties claim, so equal replicas race the queue and FIFO
-        decides — no livelock, and a draining/quiesced/broken replica
-        simply stops claiming while its active sequences finish."""
+        — its prefix pages are pinned locally).
+
+        Dispatch order of preference (the prefix-affinity policy, see
+        serving/sessions.py): a queue head stamped with an affinity
+        hint goes to its PREFERRED replica — every other replica defers
+        while the hint is FRESH (within ``affinity_timeout_s``) and the
+        target could still claim it; a stale or unservable hint is
+        STRIPPED (``serving.affinity.fallbacks``) so the head can never
+        wedge behind a dead, draining, breaker-open, or persistently
+        full preference.  Unstamped (or stripped) heads fall back to
+        least-loaded-by-free-slots: claim only when no claim-eligible
+        sibling has MORE free seats; ties claim, so equal replicas race
+        the queue and FIFO decides — no livelock."""
         if rep.force_serve and not rep.decode_failed:
             # pool stop-drain: every queued generation must reach a
             # terminal outcome NOW
             return True
+        self._session_sweep()
         if (not rep.active or rep.draining or rep.decode_failed
                 or not rep.decode_breaker.allow()):
             return False
+        if rep.role == "decode":
+            # pure decode replica: fresh prompts reach it only as
+            # handoff packets from prefill-role siblings
+            return False
+        head = self._decode_queue.peek()
+        aff = getattr(head, "affinity", None) if head is not None else None
+        if aff is not None:
+            if aff == rep.index:
+                return True
+            target = (self._replicas[aff]
+                      if 0 <= aff < len(self._replicas) else None)
+            fresh = (head.affinity_ts is not None
+                     and (time.perf_counter() - head.affinity_ts
+                          <= self._affinity_timeout_s))
+            if (fresh and target is not None
+                    and self._decode_claimable(target)):
+                # the warm replica will claim it shortly: defer (it may
+                # be momentarily full — a retirement frees a seat well
+                # within the staleness window)
+                return False
+            # staleness bound: affinity never overrides health or
+            # sustained overload — strip the hint, serve least-loaded
+            head.affinity = None
+            head.affinity_ts = None
+            _affinity_fallbacks.inc()
         mine = rep.decoder.free_slots()
         others = [r.decoder.free_slots() for r in self._replicas
-                  if r is not rep and self._decode_ready(r)]
+                  if r is not rep and self._decode_claimable(r)]
         return not others or mine >= max(others)
+
+    def _may_claim(self, rep, req):
+        """Per-POP claim check, run by the shared queue UNDER ITS LOCK
+        against the head ``rep`` is about to pop.  The gate above is a
+        peek-then-pop heuristic: two replicas can each approve their
+        own momentary head, race the pop, and claim each other's
+        affinity-tagged request — this predicate closes that window by
+        deciding on the request actually being popped.  Fast and
+        lock-free by contract: reads the hint + timestamp, strips a
+        stale hint (same staleness bound as the gate — a hint never
+        overrides liveness for long), refuses a fresh hint aimed
+        elsewhere (the warm replica pops it instead)."""
+        aff = getattr(req, "affinity", None)
+        if aff is None or aff == rep.index:
+            return True
+        if (req.affinity_ts is not None
+                and (time.perf_counter() - req.affinity_ts
+                     <= self._affinity_timeout_s)):
+            return False
+        req.affinity = None
+        req.affinity_ts = None
+        _affinity_fallbacks.inc()
+        return True
+
+    def _session_sweep(self):
+        """Time-gated TTL sweep of the session store, piggybacked on
+        the decode gate (runs on whichever worker hits the gate next —
+        no extra thread): expired sessions release their pins through
+        the owning schedulers' release queues."""
+        if self._sessions is None:
+            return
+        now = time.perf_counter()
+        if now - self._session_sweep_ts < 1.0:
+            return
+        self._session_sweep_ts = now
+        self._sessions.expire(now)
+
+    def _dispatch_handoff(self, origin, packet):
+        """Route one staged prefill->decode KV packet (roles mode) to
+        the decode-capable replica with the most free seats — called on
+        the ORIGIN (prefill) replica's worker thread by its scheduler's
+        ``on_handoff`` hook.  Ready replicas are preferred, but a
+        quiesced/draining one still accepts (injection is ungated: its
+        worker seats packets even while it refuses fresh queue claims),
+        so an autoscale park can never wedge an in-flight conversation.
+        Returns True once a replica accepted the packet."""
+        cands = [r for r in self._replicas
+                 if r.role != "prefill" and r.decoder is not None
+                 and not r.decode_failed]
+        cands.sort(key=lambda r: (self._decode_ready(r),
+                                  r.decoder.free_slots()), reverse=True)
+        for r in cands:
+            if r.decoder.inject_handoff(packet):
+                if self._telemetry.recording:
+                    self._telemetry.emit({
+                        "type": "decode_handoff", "ts": time.time(),
+                        "source": "serving", "seq": packet.req.seq,
+                        "leg": "dispatch", "origin": origin.index,
+                        "dest": r.index, "pages": packet.n_pages,
+                    })
+                return True
+        return False
 
     def _revive_decoder(self, rep):
         """The supervisor's restart wrapper for one replica's decode
@@ -766,6 +936,14 @@ class ReplicaPool:
         _decode_replays.inc()
         req.prompt = j.resume_prompt()
         req.max_new_tokens = j.remaining()
+        # the old hint likely points at the replica that just died —
+        # re-stamp against live state (warm prefix pages that survived
+        # elsewhere still attract the replay; a dead target would only
+        # stall the queue head until the staleness bound strips it)
+        req.affinity = None
+        req.affinity_ts = None
+        if self._affinity_timeout_s > 0:
+            self._stamp_affinity(req)
         if self._telemetry.recording:
             self._telemetry.emit({
                 "type": "decode_replay", "ts": time.time(),
@@ -864,7 +1042,10 @@ class ReplicaPool:
                 "admitted": self._decode_queue.last_seq(),
                 "ready_replicas": sum(1 for r in self._replicas
                                       if self._decode_ready(r)),
+                "roles": [r.role for r in self._replicas],
             }
+            if self._sessions is not None:
+                h["decode"]["sessions"] = self._sessions.stats()
         if self._supervisor is not None:
             h["workers"] = self._supervisor.stats()
         return h
@@ -951,7 +1132,7 @@ class ReplicaPool:
 
     def generate_async(self, prompt, max_new_tokens=None, deadline_ms=None,
                        priority=None, temperature=None, seed=None,
-                       tenant=None):
+                       tenant=None, session=None):
         """Admit one generation into the SHARED decode queue; whichever
         least-loaded decode-ready replica claims it serves it — and if
         that replica dies mid-decode, the journal replays the sequence
@@ -1008,23 +1189,84 @@ class ReplicaPool:
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
         greq = GenerateRequest(tokens, n_new, deadline=deadline,
                                priority=priority, temperature=temperature,
-                               seed=seed)
+                               seed=seed, session=session)
         # stamp the accounting labels BEFORE put: the admission raise
         # paths read them for the labeled rejected counters
         greq.tenant = tenant
         greq.model = self.model_label
+        if self._affinity_timeout_s > 0:
+            self._stamp_affinity(greq)
         req = self._decode_queue.put(greq)
         _decode_requests.inc()
         return req
 
+    def _stamp_affinity(self, req):
+        """Stamp the admission-time placement hint: the session's
+        sticky replica first (where its pinned pages live), the replica
+        with the LONGEST warm prefix of this prompt second (read-only
+        chain-hash peek per claim-eligible replica — hashes computed
+        once), no hint otherwise.  Best-effort by design: the peek
+        races worker-side cache mutation, and a wrong hint only costs
+        placement (the gate's staleness bound strips it)."""
+        pref = None
+        if self._sessions is not None and req.session is not None:
+            rec = self._sessions.get(req.session)
+            if rec is not None:
+                target = (self._replicas[rec.replica]
+                          if 0 <= rec.replica < len(self._replicas)
+                          else None)
+                if target is not None and self._decode_claimable(target):
+                    pref = rec.replica
+                    _affinity_sticky.inc()
+                elif target is not None:
+                    # the sticky replica exists but is draining, parked,
+                    # breaker-open, or dead: health overrides affinity —
+                    # count the abandoned preference and fall through to
+                    # prefix-match / least-loaded
+                    _affinity_fallbacks.inc()
+        if pref is None and self._decode_config.prefix_cache:
+            hashes = self._replicas[0].decoder._cache.prefix_hashes(
+                req.prompt)
+            if hashes:
+                best, best_n = None, 0
+                for r in self._replicas:
+                    if not self._decode_claimable(r):
+                        continue
+                    n = r.decoder._cache.peek_hashes(hashes)
+                    if n > best_n:
+                        best, best_n = r.index, n
+                if best is not None:
+                    pref = best
+                    _affinity_prefix.inc()
+        if pref is None:
+            _affinity_none.inc()
+            return
+        req.affinity = pref
+        req.affinity_ts = time.perf_counter()
+
+    def end_session(self, session):
+        """Explicitly finish a conversation: drop its store record and
+        release its pinned pages (freed on the owning replica's worker
+        at its next iteration).  True when the session existed."""
+        if self._sessions is None:
+            return False
+        return self._sessions.end_session(session)
+
+    @property
+    def sessions(self):
+        """The pool's :class:`~.sessions.SessionStore` (None when
+        sessions are disabled)."""
+        return self._sessions
+
     def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
                  timeout=None, priority=None, temperature=None, seed=None,
-                 tenant=None):
+                 tenant=None, session=None):
         """Synchronous generate: the generated int32 token ids."""
         return self.generate_async(
             prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
             priority=priority, temperature=temperature,
-            seed=seed, tenant=tenant).result(timeout=timeout)
+            seed=seed, tenant=tenant,
+            session=session).result(timeout=timeout)
 
     def drain_decode(self, timeout=None):
         """Block until no generation is queued, parked, or decoding

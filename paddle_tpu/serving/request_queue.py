@@ -523,22 +523,42 @@ class RequestQueue:
             self._not_empty.notify()
         return request
 
-    def get(self, timeout=None, max_rows=None):
+    def get(self, timeout=None, max_rows=None, accept=None):
         """Pop the highest-priority head request, waiting up to
         ``timeout`` seconds; None on timeout or when closed-and-empty.
         With ``max_rows``, only pops a lane head that FITS (head.rows <=
         max_rows) — the batcher's coalesce loop stays FIFO per lane
         instead of searching the queue for a filler (a lower-priority
         head that fits may ride along as filler behind a too-big
-        higher-priority head)."""
+        higher-priority head).  With ``accept``, only pops a lane head
+        the predicate approves — evaluated UNDER the queue lock against
+        the head actually popped, so two consumers racing on the same
+        queue can never claim each other's affinity-tagged head (a
+        peek-then-pop gate alone cannot close that window).  The
+        predicate must be fast and lock-free (it runs under the queue
+        lock); a refused head stays queued for the consumer it is
+        tagged for."""
         with self._lock:
             if not self._depth:
                 if self._closed:
                     return None
                 self._not_empty.wait(timeout)
-            return self._pop_locked(max_rows)
+            return self._pop_locked(max_rows, accept)
 
-    def _pop_locked(self, max_rows=None):
+    def peek(self):
+        """The head request :meth:`get` would pop right now, WITHOUT
+        popping it — the replica pool's affinity-aware claim gates read
+        the head's preferred-replica tag before deciding whether to
+        pull.  Best-effort by design: between the peek and the pull
+        another consumer may pop a different head (aging can flip the
+        lane) — affinity is a placement hint, never a correctness
+        dependency, so a stale answer only skews one claim decision."""
+        with self._lock:
+            pick = self._select_locked(None, None)
+            return self._lanes[pick][0] if pick is not None else None
+
+    def _select_locked(self, max_rows, accept=None):
+        """The lane :meth:`get` pops from (aging-aware), or None."""
         pick = None
         if self.starvation_s is not None and self._depth:
             # aging: the OLDEST head that has starved past the threshold
@@ -551,6 +571,7 @@ class RequestQueue:
                 lane = self._lanes[cls]
                 if (lane and lane[0].enqueue_ts <= cutoff
                         and (max_rows is None or lane[0].rows <= max_rows)
+                        and (accept is None or accept(lane[0]))
                         and (oldest is None
                              or lane[0].enqueue_ts < oldest)):
                     oldest = lane[0].enqueue_ts
@@ -558,9 +579,14 @@ class RequestQueue:
         if pick is None:
             for cls in PRIORITY_CLASSES:
                 lane = self._lanes[cls]
-                if lane and (max_rows is None or lane[0].rows <= max_rows):
+                if (lane and (max_rows is None or lane[0].rows <= max_rows)
+                        and (accept is None or accept(lane[0]))):
                     pick = cls
                     break
+        return pick
+
+    def _pop_locked(self, max_rows=None, accept=None):
+        pick = self._select_locked(max_rows, accept)
         if pick is None:
             return None
         req = self._lanes[pick].popleft()

@@ -72,6 +72,7 @@ from .errors import (
     ServingTimeout,
 )
 from .replica_pool import ReplicaPool
+from .sessions import scoped_session
 from .request_queue import DEFAULT_PRIORITY, PRIORITY_CLASSES, note_rejected
 
 __all__ = ["ModelRouter", "TenantQuota", "RoutedRequest"]
@@ -739,10 +740,14 @@ class ModelRouter:
 
     def generate_async(self, name, prompt, max_new_tokens=None,
                        deadline_ms=None, priority=None, temperature=None,
-                       seed=None, tenant=None):
+                       seed=None, tenant=None, session=None):
         """Route one generation (deployment's pools must be built with
         ``decode_model=`` in their pool kwargs).  Quota charges one row
-        per generation; parking and activation work as for predict."""
+        per generation; parking and activation work as for predict.
+
+        ``session=`` tags the turn of a conversation; the id is scoped
+        per (deployment, tenant) before it reaches the pool, so two
+        tenants reusing the same session string can never share KV."""
         if self._state == "stopped":
             raise ServingClosed("model router is stopped")
         dep = self._dep(name)
@@ -756,15 +761,19 @@ class ModelRouter:
         self._router_counter("serving.router.requests", dep, ver).inc()
         ms = deadline_ms if deadline_ms is not None \
             else self.default_deadline_ms
+        scoped = None if session is None \
+            else scoped_session(dep.name, tenant, session)
         payload = {"prompt": prompt, "max_new_tokens": max_new_tokens,
-                   "temperature": temperature, "seed": seed}
+                   "temperature": temperature, "seed": seed,
+                   "session": scoped}
         try:
             if pool is not None:
                 try:
                     inner = pool.generate_async(
                         prompt, max_new_tokens=max_new_tokens,
                         deadline_ms=ms, priority=priority,
-                        temperature=temperature, seed=seed, tenant=tenant)
+                        temperature=temperature, seed=seed, tenant=tenant,
+                        session=scoped)
                 except ServingClosed:
                     inner = self._park(dep, ver, "generate", payload, ms,
                                        priority, tenant)
@@ -781,12 +790,30 @@ class ModelRouter:
 
     def generate(self, name, prompt, max_new_tokens=None, deadline_ms=None,
                  priority=None, temperature=None, seed=None, tenant=None,
-                 timeout=None):
+                 session=None, timeout=None):
         return self.generate_async(
             name, prompt, max_new_tokens=max_new_tokens,
             deadline_ms=deadline_ms, priority=priority,
             temperature=temperature, seed=seed,
-            tenant=tenant).result(timeout=timeout)
+            tenant=tenant, session=session).result(timeout=timeout)
+
+    def end_session(self, name, session, tenant=None):
+        """Explicitly finish a conversation on ``name``'s ACTIVE
+        version: releases the session's pinned KV pages and drops the
+        record.  Returns True when the session existed.  (A cold-tier
+        demotion drops a deployment's sessions wholesale — the pool's
+        stop path clears its store — so ending them is only needed to
+        reclaim pins early.)"""
+        if self._state == "stopped":
+            raise ServingClosed("model router is stopped")
+        dep = self._dep(name)
+        with self._route_lock:
+            ver = self._pick_locked(dep)
+        pool = ver.pool
+        if pool is None or getattr(pool, "sessions", None) is None:
+            return False
+        return pool.end_session(
+            scoped_session(dep.name, tenant, session))
 
     def _park(self, dep, ver, kind, payload, deadline_ms, priority,
               tenant):
@@ -853,7 +880,8 @@ class ModelRouter:
                             deadline_ms=remaining_ms,
                             priority=proxy.priority,
                             temperature=p["temperature"], seed=p["seed"],
-                            tenant=proxy.tenant)
+                            tenant=proxy.tenant,
+                            session=p.get("session"))
                     break
                 except ServingQueueFull:
                     if (self._state == "stopped"

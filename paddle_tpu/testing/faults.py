@@ -74,6 +74,7 @@ __all__ = [
     "poison_request",
     "kill_worker",
     "kill_replica_mid_decode",
+    "kill_session_owner",
     "corrupt_kv_page",
 ]
 
@@ -363,6 +364,29 @@ def kill_replica_mid_decode(index, min_tokens=1):
         raise WorkerKilled("injected replica kill mid-decode (%s)" % name)
 
     with _serve_fault_installed(hook):
+        yield fired
+
+
+@contextlib.contextmanager
+def kill_session_owner(pool, session, min_tokens=1):
+    """KILL the replica that OWNS a parked conversation, mid-decode of
+    its next turn: reads the session's sticky replica from the pool's
+    :class:`~..serving.sessions.SessionStore` (without bumping the LRU)
+    and arms :func:`kill_replica_mid_decode` on exactly that replica —
+    the conversational variant of the kill-mid-decode contract.  The
+    dead owner takes the session's pinned KV pages down with it; the
+    turn must still complete BITWISE on a sibling, because the turn's
+    prompt carries the full history and the journal replays prompt +
+    accepted (sessions trade recompute, never correctness).  Raises
+    ``LookupError`` when the session isn't parked (nothing to kill).
+    Yields the one-item kill-count list."""
+    store = pool.sessions
+    rec = None if store is None else store.get(session, touch=False)
+    if rec is None:
+        raise LookupError("session %r is not parked on this pool"
+                          % (session,))
+    with kill_replica_mid_decode(rec.replica,
+                                 min_tokens=min_tokens) as fired:
         yield fired
 
 

@@ -1,0 +1,250 @@
+"""Unit coverage for the conversational-sessions building blocks:
+SessionStore (TTL + capacity LRU semantics, pin release on every drop
+path), scoped_session namespacing, and the PagedKVCache session-pin
+primitives (pin_prefix / peek_hashes / the leaked-refcount stats
+sweep).  The end-to-end behavior — bitwise warm turns, affinity
+routing, owner-kill resume, role handoff — lives in the subprocess
+gate (test_sessions_gate.py / tools/check_sessions.py)."""
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from paddle_tpu.serving.sessions import (SessionStore,  # noqa: E402
+                                         scoped_session)
+
+
+class _ReleaseLog:
+    """Release callback double: records every page batch it was handed."""
+
+    def __init__(self):
+        self.batches = []
+
+    def __call__(self, pages):
+        self.batches.append(list(pages))
+
+    @property
+    def pages(self):
+        return [p for b in self.batches for p in b]
+
+
+def test_store_park_get_touch_and_stats():
+    store = SessionStore(capacity=4, ttl_s=None)
+    rel = _ReleaseLog()
+    rec = store.park("a", replica=1, history_len=24, pages=[3, 4, 5],
+                     release=rel)
+    assert rec.turns == 1 and rec.replica == 1 and rec.pages == [3, 4, 5]
+    assert store.get("a").history_len == 24
+    assert store.get("missing") is None
+    st = store.stats()
+    assert st["active"] == 1 and st["pinned_pages"] == 3
+    assert rel.pages == []  # nothing released yet
+
+
+def test_store_repark_replaces_and_releases_old_pins():
+    store = SessionStore(capacity=4, ttl_s=None)
+    rel = _ReleaseLog()
+    store.park("a", replica=0, history_len=24, pages=[3, 4], release=rel)
+    rec = store.park("a", replica=2, history_len=48, pages=[7, 8, 9],
+                     release=rel)
+    # the new turn's pins replace the old record's — stale pins released
+    assert rec.turns == 2 and rec.replica == 2
+    assert rel.batches == [[3, 4]]
+    assert store.stats()["pinned_pages"] == 3
+
+
+def test_store_capacity_evicts_lru_first():
+    store = SessionStore(capacity=2, ttl_s=None)
+    logs = {k: _ReleaseLog() for k in "abc"}
+    store.park("a", 0, 8, [1], logs["a"])
+    store.park("b", 0, 8, [2], logs["b"])
+    store.get("a")                       # bump: now b is LRU
+    store.park("c", 0, 8, [3], logs["c"])
+    assert store.keys() == ["a", "c"]
+    assert logs["b"].pages == [2]        # evictee's pins released
+    assert logs["a"].pages == [] and logs["c"].pages == []
+
+
+def test_store_ttl_expiry_lazy_and_swept():
+    store = SessionStore(capacity=8, ttl_s=0.05)
+    rel = _ReleaseLog()
+    store.park("lazy", 0, 8, [1, 2], rel)
+    store.park("swept", 0, 8, [3], rel)
+    import time
+    time.sleep(0.08)
+    # get() lazily expires the record it was about to return
+    assert store.get("lazy") is None
+    assert rel.batches == [[1, 2]]
+    # the supervisor-tick sweep catches the rest
+    assert store.expire() == 1
+    assert rel.pages == [1, 2, 3]
+    assert store.stats()["active"] == 0
+
+
+def test_store_end_session_and_clear_release_pins():
+    store = SessionStore(capacity=8, ttl_s=None)
+    rel = _ReleaseLog()
+    store.park("a", 0, 8, [1], rel)
+    store.park("b", 0, 8, [2, 3], rel)
+    assert store.end_session("a") is True
+    assert store.end_session("a") is False
+    assert rel.pages == [1]
+    assert store.clear() == 1
+    assert sorted(rel.pages) == [1, 2, 3]
+    assert len(store) == 0
+
+
+def test_store_release_failure_does_not_break_upkeep():
+    store = SessionStore(capacity=8, ttl_s=None)
+
+    def boom(pages):
+        raise RuntimeError("scheduler gone")
+
+    store.park("a", 0, 8, [1], boom)
+    assert store.end_session("a") is True  # swallow, don't propagate
+
+
+def test_scoped_session_namespacing():
+    a = scoped_session("dep", "tenant-a", "chat-1")
+    b = scoped_session("dep", "tenant-b", "chat-1")
+    c = scoped_session("dep2", "tenant-a", "chat-1")
+    assert len({a, b, c}) == 3
+    # a crafted session id can't forge another tenant's scope: the
+    # separator is unrepresentable in validated names
+    assert scoped_session("d", "t", "x") != scoped_session("d", None,
+                                                           "t\x1fx")
+    assert scoped_session("d", None, "s") == scoped_session("d", "", "s")
+
+
+# -- PagedKVCache session-pin primitives ---------------------------------
+
+def _cache(num_pages=8, page_size=4):
+    from paddle_tpu.serving.kv_cache import PagedKVCache
+
+    return PagedKVCache(num_layers=1, num_pages=num_pages,
+                        page_size=page_size, num_heads=1, head_dim=4,
+                        max_seq_len=num_pages * page_size)
+
+
+def _indexed_chain(cache, n_pages, seed=0):
+    """Allocate, register, and retire an n_pages-long prefix chain;
+    returns (tokens, hashes, pages) with the pages parked rc=0 in the
+    reuse LRU — the state a finished turn leaves behind."""
+    toks = np.arange(seed * 100, seed * 100 + n_pages * cache.page_size,
+                     dtype=np.int32)
+    hashes = cache.prefix_hashes(toks)
+    pages = cache.alloc(n_pages)
+    for i, p in enumerate(pages):
+        assert cache.register_prefix(hashes, i, p)
+    cache.free(pages)
+    return toks, hashes, pages
+
+
+def test_pin_prefix_revives_and_blocks_eviction():
+    cache = _cache()
+    toks, hashes, pages = _indexed_chain(cache, 2)
+    assert cache.used_pages == 0
+    assert cache.peek_hashes(hashes) == 2
+    # no len-1 cap: the LAST full page is what the next turn wants warm
+    assert cache.pin_prefix(toks) == pages
+    assert cache.used_pages == 2
+    # pinned pages are rc>=1: allocation pressure can't evict them
+    grabbed = cache.alloc(cache.free_pages)
+    assert grabbed is not None and not set(grabbed) & set(pages)
+    assert cache.peek_hashes(hashes) == 2
+    cache.free(grabbed)
+    # dropping the pin parks the chain back in the LRU, still indexed
+    cache.free(pages)
+    assert cache.used_pages == 0
+    assert cache.peek_hashes(hashes) == 2
+    s = cache.stats()
+    assert s["rc_errors"] == [] and s["rc_sum_matches"]
+
+
+def test_pin_prefix_partial_chain_and_limit():
+    cache = _cache()
+    toks, hashes, pages = _indexed_chain(cache, 3)
+    # evict the whole chain: the pin finds nothing to revive
+    evictor = cache.alloc(cache.free_pages)
+    cache.free(evictor)
+    assert cache.pin_prefix(toks) == []
+    toks2, hashes2, pages2 = _indexed_chain(cache, 3, seed=1)
+    assert cache.pin_prefix(toks2, limit=1) == pages2[:1]
+    cache.free(pages2[:1])
+    # peek_prefix caps at (len-1)//ps like lookup_prefix
+    assert cache.peek_prefix(toks2) == 2
+    assert cache.peek_hashes(hashes2) == 3
+    s = cache.stats()
+    assert s["rc_errors"] == [] and s["rc_sum_matches"]
+
+
+def test_pin_on_live_page_counts_as_shared():
+    cache = _cache()
+    toks, hashes, pages = _indexed_chain(cache, 2)
+    mapped, _ = cache.lookup_prefix(np.concatenate(
+        [toks, np.array([7], np.int32)]))
+    assert mapped == pages          # rc 1 each: a live reader
+    assert cache.pin_prefix(toks) == pages  # rc 2: now shared
+    assert cache.shared_pages == 2
+    cache.free(pages)               # reader done
+    cache.free(pages)               # pin released
+    s = cache.stats()
+    assert s["used_pages"] == 0 and s["rc_errors"] == []
+
+
+def test_stats_sweep_flags_leaks_and_double_accounting():
+    cache = _cache()
+    toks, hashes, pages = _indexed_chain(cache, 2)
+    assert cache.stats()["rc_errors"] == []
+    # simulate an early-exit path that dropped a page without freeing:
+    # rc=0 but in neither the free list nor the LRU
+    leaked = pages[0]
+    del cache._lru[leaked]
+    errs = cache.stats()["rc_errors"]
+    assert any(p == leaked and "leaked" in why for p, _, why in errs)
+    cache._lru[leaked] = None        # restore
+    assert cache.stats()["rc_errors"] == []
+    # and a double-account: rc>0 page sitting on the free list
+    live = cache.alloc(1)
+    cache._free.append(live[0])
+    errs = cache.stats()["rc_errors"]
+    assert any(p == live[0] for p, _, why in errs)
+
+
+def test_router_scopes_sessions_per_tenant():
+    # satellite: session= through ModelRouter.generate() scoped per
+    # (deployment, tenant) — same session id from two tenants parks two
+    # distinct store records; end_session releases the right one
+    pytest.importorskip("jax")
+    from paddle_tpu import serving
+    from paddle_tpu.models import transformer as T
+
+    params, meta = T.lm_params(seed=31, vocab_size=60, n_layer=2,
+                               n_head=2, d_model=32, d_inner=64,
+                               max_length=128)
+    model = T.build_decode_model(params, meta)
+    cfg = serving.DecodeConfig(num_slots=2, page_size=8, max_seq_len=96,
+                               max_new_tokens=8, prefill_chunk_tokens=16,
+                               prefix_cache=True, queue_capacity=64)
+    r = serving.ModelRouter()
+    try:
+        r.deploy("chat", None, replicas=1, decode_model=model,
+                 decode_config=cfg)
+        prompt = np.arange(1, 21, dtype=np.int32)
+        for tenant in ("a", "b"):
+            r.generate("chat", prompt, max_new_tokens=4,
+                       temperature=0.0, tenant=tenant, session="conv",
+                       timeout=120)
+        dep = r._dep("chat")
+        store = next(iter(dep.versions.values())).pool.sessions
+        assert sorted(store.keys()) == sorted([
+            scoped_session("chat", "a", "conv"),
+            scoped_session("chat", "b", "conv")])
+        assert r.end_session("chat", "conv", tenant="a") is True
+        assert r.end_session("chat", "conv", tenant="a") is False
+        assert store.keys() == [scoped_session("chat", "b", "conv")]
+        assert r.end_session("chat", "conv", tenant="b") is True
+    finally:
+        r.stop()
